@@ -1,0 +1,81 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference: lw921014/Paddle), built on JAX/XLA/Pallas.
+
+Top-level surface parity: ``/root/reference/python/paddle/__init__.py`` —
+``paddle.*`` tensor ops, ``paddle.nn``, ``paddle.optimizer``,
+``paddle.static``, ``paddle.distributed``, ``paddle.amp``, ``paddle.io``,
+``paddle.vision``, ``paddle.jit``, ``paddle.metric``.
+
+Architecture (TPU-first, see SURVEY.md §7):
+  static Programs lower to single jitted XLA computations (static/executor);
+  dygraph runs a tape over jax Arrays (dygraph/); distributed = mesh axes +
+  XLA collectives (distributed/); hot kernels in Pallas (kernels/).
+"""
+
+from . import framework  # noqa: F401
+from .framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+    disable_static,
+    enable_static,
+    get_device,
+    in_dygraph_mode,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .framework.dtype import (  # noqa: F401
+    bfloat16,
+    bool,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from . import ops  # noqa: F401  (registers all kernels)
+from . import static  # noqa: F401
+
+__version__ = "0.1.0"
+
+# Surface modules are appended to this __init__ as they land (round 1 build
+# order follows SURVEY.md §7); optional imports below tolerate absence only
+# during the initial bring-up.
+for _mod in (
+    "nn",
+    "optimizer",
+    "io",
+    "amp",
+    "metric",
+    "vision",
+    "jit",
+    "distributed",
+    "autograd",
+    "profiler",
+    "incubate",
+    "text",
+    "hapi",
+):
+    try:
+        __import__(f"{__name__}.{_mod}")
+    except ImportError:
+        pass
+
+try:
+    from .dygraph.tensor import Tensor, to_tensor  # noqa: F401
+    from .dygraph.base import grad, no_grad  # noqa: F401
+    from .tensor_api import *  # noqa: F401,F403
+    from .io_api import load, save  # noqa: F401
+    from .framework.random import seed  # noqa: F401
+    from .hapi import Model  # noqa: F401
+    from .dygraph.parallel import DataParallel  # noqa: F401
+except ImportError:
+    pass

@@ -1,0 +1,38 @@
+"""Framework core: descs, places, dtypes, scope.
+
+Parity: layer 2 of the reference (``python/paddle/fluid/framework.py`` and
+the C++ descs under ``paddle/fluid/framework/``) — see SURVEY.md §1.
+"""
+
+from . import dtype, unique_name  # noqa: F401
+from .dtype import convert_dtype, to_jax_dtype, to_numpy_dtype  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    XPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+    _get_current_place,
+)
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    grad_var_name,
+    in_dygraph_mode,
+    name_scope,
+    program_guard,
+    _dygraph_guard,
+)
+from .scope import Scope, global_scope, scope_guard  # noqa: F401

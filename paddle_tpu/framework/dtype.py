@@ -1,0 +1,118 @@
+"""Dtype registry for the TPU-native framework.
+
+Capability parity with the reference's ``VarType.Type`` proto enum
+(``/root/reference/paddle/fluid/framework/framework.proto:106``) and the
+Python-side dtype conversion helpers
+(``/root/reference/python/paddle/fluid/data_feeder.py`` convert_dtype).
+
+TPU-first notes: the canonical training dtype on TPU is bfloat16 (MXU-native);
+float16 is accepted for API parity but bf16 is preferred by AMP.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+
+    _HAS_JAX = True
+except Exception:  # pragma: no cover
+    _HAS_JAX = False
+
+
+class DataType:
+    """Mirrors VarType.Type values that matter for tensors."""
+
+    BOOL = "bool"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FP16 = "float16"
+    BF16 = "bfloat16"
+    FP32 = "float32"
+    FP64 = "float64"
+    COMPLEX64 = "complex64"
+    COMPLEX128 = "complex128"
+
+
+# Public aliases mirroring ``paddle.float32`` etc.
+bool = "bool"  # noqa: A001
+int8 = "int8"
+uint8 = "uint8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+float32 = "float32"
+float64 = "float64"
+complex64 = "complex64"
+complex128 = "complex128"
+
+_ALL_DTYPES = {
+    "bool",
+    "int8",
+    "uint8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+}
+
+_FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
+_INT_DTYPES = {"bool", "int8", "uint8", "int16", "int32", "int64"}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalise any dtype spec (str, numpy dtype, jnp dtype) to canonical str.
+
+    Parity: ``convert_dtype`` in the reference's data_feeder.
+    """
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = dtype
+    elif isinstance(dtype, np.dtype):
+        name = dtype.name
+    elif isinstance(dtype, type) and issubclass(dtype, np.generic):
+        name = np.dtype(dtype).name
+    else:
+        # jnp dtypes / python types
+        name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    if name == "float":
+        name = "float32"
+    if name == "int":
+        name = "int64"
+    if name not in _ALL_DTYPES:
+        raise TypeError(f"Unsupported dtype: {dtype!r} -> {name}")
+    return name
+
+
+def to_numpy_dtype(dtype) -> np.dtype:
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        if _HAS_JAX:
+            return jnp.bfloat16
+        raise TypeError("bfloat16 requires jax")
+    return np.dtype(name)
+
+
+def to_jax_dtype(dtype):
+    name = convert_dtype(dtype)
+    return jnp.dtype(name) if name != "bfloat16" else jnp.bfloat16
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOAT_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INT_DTYPES

@@ -1,0 +1,77 @@
+"""Scope: name -> runtime value (jax.Array) store.
+
+Parity: ``/root/reference/paddle/fluid/framework/scope.h:52`` (hierarchical
+``Scope::NewScope/FindVar``).  Values are jax Arrays (device-resident); the
+executor reads persistables out of the scope, threads them through the jitted
+step function, and rebinds the results — the functional replacement for the
+reference's mutable ``Variable::GetMutable<LoDTensor>()``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self._parent = parent
+        self._kids = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def var(self, name: str):
+        """Find or create (returns None placeholder until set)."""
+        if name not in self._vars and (self._parent is None or not self._parent.has(name)):
+            self._vars[name] = None
+        return self.find_var(name)
+
+    def set(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.find_var(name)
+        return None
+
+    def has(self, name: str) -> bool:
+        if name in self._vars:
+            return True
+        return self._parent.has(name) if self._parent is not None else False
+
+    def local_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return guard()

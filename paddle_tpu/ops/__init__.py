@@ -1,0 +1,17 @@
+"""Operator library: importing this package registers all built-in ops.
+
+Parity: the role of ``/root/reference/paddle/fluid/operators/`` (520
+registered ops) — rebuilt as pure JAX kernels in one registry (see
+``registry.py``).  Collective ops live in ``collective_ops`` and register the
+``c_*`` family over mesh axes.
+"""
+
+from . import registry  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import activation_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
+from .dispatch import dispatch, dispatch_dygraph, dispatch_static, single  # noqa: F401
+from .registry import OpNotRegistered, get_op_def, is_registered, register_op  # noqa: F401

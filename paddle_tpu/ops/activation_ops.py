@@ -1,0 +1,127 @@
+"""Activation op kernels.
+
+Parity: ``/root/reference/paddle/fluid/operators/activation_op.{cc,cu,h}``.
+All are single jnp expressions; XLA fuses them into neighbouring matmuls on
+TPU (the role of the reference's fused activation CUDA kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _unary(fn):
+    def kernel(ins, attrs):
+        return {"Out": fn(ins["X"])}
+
+    return kernel
+
+
+register_op("relu")(_unary(jax.nn.relu))
+register_op("relu6")(_unary(lambda x: jnp.clip(x, 0.0, 6.0)))
+register_op("tanh")(_unary(jnp.tanh))
+register_op("sigmoid")(_unary(jax.nn.sigmoid))
+register_op("silu")(_unary(jax.nn.silu))
+register_op("softplus")(_unary(jax.nn.softplus))
+register_op("softsign")(_unary(jax.nn.soft_sign))
+register_op("mish")(_unary(lambda x: x * jnp.tanh(jax.nn.softplus(x))))
+register_op("logsigmoid")(_unary(jax.nn.log_sigmoid))
+
+
+@register_op("gelu")
+def gelu_kernel(ins, attrs):
+    return {"Out": jax.nn.gelu(ins["X"], approximate=attrs.get("approximate", False))}
+
+
+@register_op("leaky_relu")
+def leaky_relu_kernel(ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    return {"Out": jax.nn.leaky_relu(ins["X"], negative_slope=alpha)}
+
+
+@register_op("elu")
+def elu_kernel(ins, attrs):
+    return {"Out": jax.nn.elu(ins["X"], alpha=attrs.get("alpha", 1.0))}
+
+
+@register_op("selu")
+def selu_kernel(ins, attrs):
+    return {"Out": jax.nn.selu(ins["X"])}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid_kernel(ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    x = ins["X"]
+    return {"Out": jnp.clip(slope * x + offset, 0.0, 1.0)}
+
+
+@register_op("hard_swish")
+def hard_swish_kernel(ins, attrs):
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    x = ins["X"]
+    return {"Out": x * jnp.clip(x + offset, 0.0, threshold) / scale}
+
+
+@register_op("hard_tanh")
+def hard_tanh_kernel(ins, attrs):
+    return {"Out": jnp.clip(ins["X"], attrs.get("t_min", -1.0), attrs.get("t_max", 1.0))}
+
+
+@register_op("swish")
+def swish_kernel(ins, attrs):
+    x = ins["X"]
+    beta = attrs.get("beta", 1.0)
+    return {"Out": x * jax.nn.sigmoid(beta * x)}
+
+
+@register_op("softmax")
+def softmax_kernel(ins, attrs):
+    return {"Out": jax.nn.softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def log_softmax_kernel(ins, attrs):
+    return {"Out": jax.nn.log_softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+@register_op("prelu")
+def prelu_kernel(ins, attrs):
+    x, alpha = ins["X"], ins["Alpha"]
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and x.ndim == 4:
+        alpha = jnp.reshape(alpha, (1, -1, 1, 1))
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("hardshrink")
+def hardshrink_kernel(ins, attrs):
+    t = attrs.get("threshold", 0.5)
+    x = ins["X"]
+    return {"Out": jnp.where(jnp.abs(x) > t, x, jnp.zeros_like(x))}
+
+
+@register_op("softshrink")
+def softshrink_kernel(ins, attrs):
+    lam = attrs.get("lambda", 0.5)
+    x = ins["X"]
+    return {"Out": jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, jnp.zeros_like(x)))}
+
+
+@register_op("tanhshrink")
+def tanhshrink_kernel(ins, attrs):
+    x = ins["X"]
+    return {"Out": x - jnp.tanh(x)}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu_kernel(ins, attrs):
+    t = attrs.get("threshold", 1.0)
+    x = ins["X"]
+    return {"Out": jnp.where(x > t, x, jnp.zeros_like(x))}

@@ -1,0 +1,357 @@
+"""Collective communication ops over mesh axes.
+
+Parity: ``/root/reference/paddle/fluid/operators/collective/`` (144 files:
+``c_allreduce_{sum,max,min,prod}``, ``c_allgather``, ``c_reducescatter``,
+``c_broadcast``, ``alltoall``, ``send_v2``/``recv_v2``, ``c_concat``,
+``c_split``, ``c_identity``, ``c_embedding``,
+``c_softmax_with_cross_entropy_op.cu`` (vocab-sharded softmax+CE),
+plus the init ops ``c_comm_init*`` / ``c_gen_*_id``).
+
+TPU-first design
+----------------
+The reference addresses communicators by ``ring_id`` and manages NCCL/HCCL/
+ECCL comm objects + dedicated comm streams + explicit sync ops
+(``c_sync_calc_stream`` etc.).  Here a ring_id simply NAMES A MESH AXIS
+(registered by ``paddle_tpu.distributed``): inside ``shard_map``/pjit the
+kernels lower to ``lax.psum / all_gather / psum_scatter / all_to_all /
+ppermute`` and XLA schedules them on ICI — there are no comm streams to sync,
+so the reference's stream-ordering ops become no-ops.  Outside any mesh
+context (single device) every collective degrades to its 1-rank semantics,
+which is what makes single-chip tests of distributed models work.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import GRAD_SUFFIX, register_op
+
+# ring_id -> mesh axis name (or tuple of names); maintained by
+# paddle_tpu.distributed.collective
+_RING_AXES: Dict[int, object] = {}
+
+
+def set_ring_axis(ring_id: int, axis_name) -> None:
+    _RING_AXES[int(ring_id)] = axis_name
+
+
+def get_ring_axis(ring_id: int):
+    return _RING_AXES.get(int(ring_id))
+
+
+def _axis_of(attrs) -> Optional[object]:
+    axis = attrs.get("axis_name")
+    if axis is None:
+        axis = get_ring_axis(attrs.get("ring_id", 0))
+    return axis
+
+
+def _active(axis) -> bool:
+    """True when tracing under the named mapped axis (inside shard_map)."""
+    if axis is None:
+        return False
+    try:
+        lax.axis_size(axis)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def _allreduce(red):
+    def kernel(ins, attrs):
+        x = ins["X"]
+        axis = _axis_of(attrs)
+        if not _active(axis):
+            return {"Out": x}
+        return {"Out": red(x, axis)}
+
+    return kernel
+
+
+def _c_allreduce_sum_grad_maker(op, no_grad_set):
+    # allreduce-sum forward => identity backward (megatron g-op)
+    return [
+        {
+            "type": "c_identity",
+            "inputs": {"X": [n + GRAD_SUFFIX for n in op.output("Out")]},
+            "outputs": {"Out": [n + GRAD_SUFFIX for n in op.input("X")]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+register_op("c_allreduce_sum", grad_maker=_c_allreduce_sum_grad_maker)(
+    _allreduce(lax.psum)
+)
+register_op("c_allreduce_max", no_grad=True)(_allreduce(lax.pmax))
+register_op("c_allreduce_min", no_grad=True)(_allreduce(lax.pmin))
+register_op("c_allreduce_prod", no_grad=True)(
+    _allreduce(lambda x, a: jnp.exp(lax.psum(jnp.log(x), a)))
+)
+register_op("mp_allreduce_sum", grad_maker=_c_allreduce_sum_grad_maker)(
+    _allreduce(lax.psum)
+)
+
+
+def _c_identity_grad_maker(op, no_grad_set):
+    # identity forward => allreduce-sum backward (megatron f-op)
+    return [
+        {
+            "type": "c_allreduce_sum",
+            "inputs": {"X": [n + GRAD_SUFFIX for n in op.output("Out")]},
+            "outputs": {"Out": [n + GRAD_SUFFIX for n in op.input("X")]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+@register_op("c_identity", grad_maker=_c_identity_grad_maker)
+def c_identity_kernel(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("c_broadcast")
+def c_broadcast_kernel(ins, attrs):
+    x = ins["X"]
+    axis = _axis_of(attrs)
+    if not _active(axis):
+        return {"Out": x}
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": lax.psum(masked, axis)}
+
+
+@register_op("c_allgather")
+def c_allgather_kernel(ins, attrs):
+    """Concatenates along dim 0 across ranks (parity: c_allgather_op)."""
+    x = ins["X"]
+    axis = _axis_of(attrs)
+    if not _active(axis):
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, axis, axis=0, tiled=True)}
+
+
+@register_op("c_reducescatter")
+def c_reducescatter_kernel(ins, attrs):
+    x = ins["X"]
+    axis = _axis_of(attrs)
+    if not _active(axis):
+        return {"Out": x}
+    return {"Out": lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)}
+
+
+@register_op("alltoall")
+def alltoall_kernel(ins, attrs):
+    x = ins["X"]
+    axis = _axis_of(attrs)
+    if not _active(axis):
+        return {"Out": x}
+    n = lax.axis_size(axis)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
+    return {"Out": out.reshape(x.shape)}
+
+
+@register_op("c_concat")
+def c_concat_kernel(ins, attrs):
+    """All-gather along the LAST dim (TP activation regroup; c_concat_op)."""
+    x = ins["X"]
+    axis = _axis_of(attrs)
+    if not _active(axis):
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)}
+
+
+@register_op("c_split")
+def c_split_kernel(ins, attrs):
+    """Take this rank's slice of the last dim (c_split_op)."""
+    x = ins["X"]
+    axis = _axis_of(attrs)
+    if not _active(axis):
+        return {"Out": x}
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    sz = x.shape[-1] // n
+    return {"Out": lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=x.ndim - 1)}
+
+
+@register_op("send_v2", no_grad=True)
+def send_v2_kernel(ins, attrs):
+    # p2p is expressed as ppermute pairs in the pipeline engine
+    # (meta_parallel/pipeline); a lone send is a no-op in SPMD.
+    return {}
+
+
+@register_op("recv_v2", no_grad=True)
+def recv_v2_kernel(ins, attrs):
+    raise NotImplementedError(
+        "recv_v2 outside the pipeline engine is not supported; use "
+        "paddle_tpu.distributed.fleet pipeline parallel (ppermute-based)"
+    )
+
+
+@register_op("partial_send", no_grad=True)
+def partial_send_kernel(ins, attrs):
+    return {}
+
+
+@register_op("barrier", no_grad=True)
+def barrier_kernel(ins, attrs):
+    return {"Out": ins.get("X", jnp.zeros((1,), jnp.int32))}
+
+
+@register_op("c_sync_calc_stream", no_grad=True)
+def c_sync_calc_stream_kernel(ins, attrs):
+    # XLA orders collectives; stream sync is a no-op (see module docstring)
+    return {"Out": ins["X"]}
+
+
+@register_op("c_sync_comm_stream", no_grad=True)
+def c_sync_comm_stream_kernel(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("c_wait_compute", no_grad=True)
+def c_wait_compute_kernel(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+# ---------------------------------------------------------------------------
+# Sharded embedding + vocab-parallel softmax CE
+# ---------------------------------------------------------------------------
+
+
+def _c_embedding_grad_maker(op, no_grad_set):
+    return [
+        {
+            "type": "c_embedding_grad",
+            "inputs": {
+                "W": op.input("W"),
+                "Ids": op.input("Ids"),
+                "Out" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op.output("Out")],
+            },
+            "outputs": {"W" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op.input("W")]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+@register_op("c_embedding", nondiff_slots=("Ids",), grad_maker=_c_embedding_grad_maker)
+def c_embedding_kernel(ins, attrs):
+    """Vocab-sharded embedding (parity: c_embedding_op).  Each rank holds rows
+    [start, start+n); out-of-range ids contribute zero, then psum over the
+    model-parallel axis completes the lookup."""
+    w, ids = ins["W"], ins["Ids"]
+    start = attrs.get("start_index", 0)
+    axis = _axis_of(attrs)
+    n = w.shape[0]
+    local = ids - start
+    in_range = (local >= 0) & (local < n)
+    safe = jnp.clip(local, 0, n - 1)
+    out = jnp.take(w, safe, axis=0)
+    out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
+    if _active(axis):
+        out = lax.psum(out, axis)
+    return {"Out": out}
+
+
+@register_op("c_embedding_grad", no_grad=True)
+def c_embedding_grad_kernel(ins, attrs):
+    w, ids = ins["W"], ins["Ids"]
+    dout = ins["Out" + GRAD_SUFFIX]
+    start = attrs.get("start_index", 0)
+    n = w.shape[0]
+    local = ids - start
+    in_range = (local >= 0) & (local < n)
+    safe = jnp.clip(local, 0, n - 1)
+    dmask = jnp.where(in_range[..., None], dout, jnp.zeros_like(dout))
+    dw = jnp.zeros_like(w).at[safe.reshape(-1)].add(
+        dmask.reshape(-1, dout.shape[-1]).astype(w.dtype)
+    )
+    return {"W" + GRAD_SUFFIX: dw}
+
+
+def _c_swce_grad_maker(op, no_grad_set):
+    return [
+        {
+            "type": "c_softmax_with_cross_entropy_grad",
+            "inputs": {
+                "Softmax": op.output("Softmax"),
+                "Label": op.input("Label"),
+                "Loss" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op.output("Loss")],
+            },
+            "outputs": {
+                "Logits" + GRAD_SUFFIX: [n + GRAD_SUFFIX for n in op.input("Logits")]
+            },
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+@register_op(
+    "c_softmax_with_cross_entropy",
+    nondiff_slots=("Label",),
+    nondiff_out_slots=("Softmax",),
+    grad_maker=_c_swce_grad_maker,
+)
+def c_softmax_with_cross_entropy_kernel(ins, attrs):
+    """Vocab-parallel fused softmax+CE (parity:
+    c_softmax_with_cross_entropy_op.cu).  Logits' last dim is sharded over the
+    model-parallel axis; max/sum/label-pick are psum/pmax-combined so no rank
+    ever materialises the full vocab row."""
+    logits, label = ins["Logits"], ins["Label"]
+    axis = _axis_of(attrs)
+    vocab_local = logits.shape[-1]
+    if _active(axis):
+        rank = lax.axis_index(axis)
+        start = rank * vocab_local
+        gmax = lax.pmax(jnp.max(logits, axis=-1, keepdims=True), axis)
+    else:
+        start = 0
+        gmax = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - gmax
+    exp = jnp.exp(shifted)
+    sumexp = jnp.sum(exp, axis=-1, keepdims=True)
+    if _active(axis):
+        sumexp = lax.psum(sumexp, axis)
+    softmax = exp / sumexp
+    lab = label
+    squeeze = False
+    if lab.ndim == logits.ndim:
+        lab = jnp.squeeze(lab, -1)
+        squeeze = True
+    local = lab - start
+    in_range = (local >= 0) & (local < vocab_local)
+    safe = jnp.clip(local, 0, vocab_local - 1)
+    picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+    picked = jnp.where(in_range[..., None], picked, jnp.zeros_like(picked))
+    if _active(axis):
+        picked = lax.psum(picked, axis)
+    loss = jnp.log(sumexp) - picked
+    return {"Softmax": softmax, "Loss": loss.astype(logits.dtype)}
+
+
+@register_op("c_softmax_with_cross_entropy_grad", no_grad=True)
+def c_softmax_with_cross_entropy_grad_kernel(ins, attrs):
+    softmax, label = ins["Softmax"], ins["Label"]
+    dloss = ins["Loss" + GRAD_SUFFIX]
+    axis = _axis_of(attrs)
+    vocab_local = softmax.shape[-1]
+    if _active(axis):
+        start = lax.axis_index(axis) * vocab_local
+    else:
+        start = 0
+    lab = label
+    if lab.ndim == softmax.ndim:
+        lab = jnp.squeeze(lab, -1)
+    local = lab - start
+    in_range = (local >= 0) & (local < vocab_local)
+    safe = jnp.clip(local, 0, vocab_local - 1)
+    onehot = jax.nn.one_hot(safe, vocab_local, dtype=softmax.dtype)
+    onehot = jnp.where(in_range[..., None], onehot, jnp.zeros_like(onehot))
+    return {"Logits" + GRAD_SUFFIX: (softmax - onehot) * dloss}

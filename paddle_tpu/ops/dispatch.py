@@ -1,0 +1,110 @@
+"""Unified op dispatch for static and dygraph modes.
+
+Role parity: the reference's generated per-op eager functions
+(``/root/reference/paddle/fluid/pybind/op_function_generator.cc:519`` ->
+``core.ops.*`` / ``paddle._C_ops``) for dygraph, and
+``LayerHelper.append_op`` (``/root/reference/python/paddle/fluid/layer_helper.py``)
+for static graph building.  Every ``paddle.*`` / ``paddle.nn.functional.*``
+function funnels through :func:`dispatch`, which branches on
+``in_dygraph_mode()`` exactly like the reference's
+``tensor/math.py:146-168`` pattern — but both branches share ONE kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework import program as fw
+from ..framework import unique_name
+from ..framework.dtype import to_jax_dtype
+from . import registry
+
+
+def _probe_out_slots(op_def, ins_structs, attrs):
+    return registry.abstract_eval(op_def, ins_structs, attrs)
+
+
+def dispatch_static(
+    op_type: str,
+    inputs: Dict[str, List[Any]],
+    attrs: Dict[str, Any],
+    block: Optional[fw.Block] = None,
+    outputs: Optional[Dict[str, List[Any]]] = None,
+    stop_gradient: bool = False,
+) -> Dict[str, List[fw.Variable]]:
+    """Append an op to the current (or given) block, creating output vars."""
+    if block is None:
+        block = fw.default_main_program().current_block()
+    op_def = registry.get_op_def(op_type)
+    norm_in: Dict[str, List[fw.Variable]] = {}
+    for slot, vals in inputs.items():
+        if vals is None:
+            continue
+        if isinstance(vals, (fw.Variable, str)):
+            vals = [vals]
+        vs = [block._var_recursive(v) if isinstance(v, str) else v for v in vals]
+        if vs:
+            norm_in[slot] = vs
+    if outputs is None:
+        ins_structs = {
+            slot: [
+                jax.ShapeDtypeStruct(
+                    tuple(17 if (s is None or s < 0) else s for s in v.shape),
+                    to_jax_dtype(v.dtype),
+                )
+                for v in vs
+            ]
+            for slot, vs in norm_in.items()
+        }
+        out_shapes = _probe_out_slots(op_def, ins_structs, attrs)
+        outputs = {}
+        for slot, vals in out_shapes.items():
+            n = len(vals) if isinstance(vals, (list, tuple)) else 1
+            outputs[slot] = [
+                block.create_var(
+                    name=unique_name.generate(f"{op_type}_{slot.lower()}"),
+                    stop_gradient=stop_gradient,
+                )
+                for _ in range(n)
+            ]
+    block.append_op(
+        type=op_type,
+        inputs={s: [v.name for v in vs] for s, vs in norm_in.items()},
+        outputs={
+            s: [v.name if isinstance(v, fw.Variable) else v for v in vs]
+            for s, vs in outputs.items()
+        },
+        attrs=attrs,
+    )
+    result: Dict[str, List[fw.Variable]] = {}
+    for slot, vs in outputs.items():
+        result[slot] = [
+            v if isinstance(v, fw.Variable) else block._var_recursive(v) for v in vs
+        ]
+    return result
+
+
+def dispatch_dygraph(
+    op_type: str,
+    inputs: Dict[str, List[Any]],
+    attrs: Dict[str, Any],
+) -> Dict[str, List[Any]]:
+    """Eager execution through the dygraph tracer (tape autograd)."""
+    from ..dygraph import tracer as dytracer
+
+    return dytracer.trace_op(op_type, inputs, attrs)
+
+
+def dispatch(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any], **kw):
+    if fw.in_dygraph_mode():
+        return dispatch_dygraph(op_type, inputs, attrs)
+    return dispatch_static(op_type, inputs, attrs, **kw)
+
+
+def single(out, slot: str = "Out"):
+    """Unwrap the single output variable/tensor of a dispatch result."""
+    v = out[slot]
+    return v[0] if isinstance(v, (list, tuple)) else v

@@ -1,0 +1,288 @@
+"""Math / elementwise / reduction / matmul op kernels.
+
+Capability parity: the reference's elementwise family
+(``/root/reference/paddle/fluid/operators/elementwise/``), reduce ops
+(``reduce_ops/``), ``matmul_v2_op``, ``mul_op``, ``sum_op``, ``scale_op``,
+``clip_op`` etc.  Each kernel is a pure jnp function; XLA fuses the
+elementwise chains that the reference fused with hand CUDA or its
+fusion_group NVRTC pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _align_y(x, y, axis: int):
+    """Paddle elementwise broadcasting: align y's dims to x starting at axis.
+
+    Parity: ``GetBroadcastDimsArrays`` in the reference's elementwise_op.h.
+    axis=-1 means standard trailing broadcast.
+    """
+    if not hasattr(y, "ndim") or y.ndim == x.ndim or axis == -1 or axis is None:
+        return y
+    pad_right = x.ndim - axis - y.ndim
+    if pad_right < 0:
+        return y
+    return jnp.reshape(y, (1,) * axis + tuple(y.shape) + (1,) * pad_right)
+
+
+def _binary(fn):
+    def kernel(ins, attrs):
+        x, y = ins["X"], ins["Y"]
+        y = _align_y(x, y, attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return kernel
+
+
+register_op("elementwise_add")(_binary(jnp.add))
+register_op("elementwise_sub")(_binary(jnp.subtract))
+register_op("elementwise_mul")(_binary(jnp.multiply))
+register_op("elementwise_div")(_binary(jnp.divide))
+register_op("elementwise_min")(_binary(jnp.minimum))
+register_op("elementwise_max")(_binary(jnp.maximum))
+register_op("elementwise_pow")(_binary(jnp.power))
+register_op("elementwise_mod")(_binary(jnp.mod))
+register_op("elementwise_floordiv")(_binary(jnp.floor_divide))
+
+
+@register_op("scale")
+def scale_kernel(ins, attrs):
+    """Parity: scale_op.cc — out = scale * (x + bias) or scale*x + bias."""
+    x = ins["X"]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * jnp.asarray(s, x.dtype) + jnp.asarray(b, x.dtype)
+    else:
+        out = (x + jnp.asarray(b, x.dtype)) * jnp.asarray(s, x.dtype)
+    return {"Out": out}
+
+
+@register_op("pow")
+def pow_kernel(ins, attrs):
+    x = ins["X"]
+    return {"Out": jnp.power(x, jnp.asarray(attrs.get("factor", 1.0), x.dtype))}
+
+
+@register_op("sum", list_slots=("X",))
+def sum_kernel(ins, attrs):
+    """Parity: sum_op.cc — adds N tensors."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("matmul_v2")
+def matmul_v2_kernel(ins, attrs):
+    """Parity: matmul_v2_op.cc.  Maps straight onto the MXU via lax.dot_general
+    (through jnp.matmul) — batched and large is the fast path on TPU."""
+    x, y = ins["X"], ins["Y"]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": jnp.matmul(x, y)}
+
+
+@register_op("matmul")
+def matmul_v1_kernel(ins, attrs):
+    """Parity: matmul_op.cc (v1: transpose_X/transpose_Y/alpha attrs)."""
+    x, y = ins["X"], ins["Y"]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": out}
+
+
+@register_op("mul")
+def mul_kernel(ins, attrs):
+    """Parity: mul_op.cc — flattens to 2-D then matmul (the FC primitive)."""
+    x, y = ins["X"], ins["Y"]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = jnp.reshape(x, (-1, _prod(xs[xnc:])))
+    y2 = jnp.reshape(y, (_prod(ys[:ync]), -1))
+    out = jnp.matmul(x2, y2)
+    return {"Out": jnp.reshape(out, tuple(xs[:xnc]) + tuple(ys[ync:]))}
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
+def _reduce(fn):
+    def kernel(ins, attrs):
+        x = ins["X"]
+        dims = attrs.get("dim", [0])
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False) or dims is None or len(dims) == 0:
+            axis = None
+        else:
+            axis = tuple(int(d) % max(x.ndim, 1) for d in dims)
+        return {"Out": fn(x, axis=axis, keepdims=keep)}
+
+    return kernel
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_any", nondiff_slots=("X",))(_reduce(jnp.any))
+register_op("reduce_all", nondiff_slots=("X",))(_reduce(jnp.all))
+
+
+@register_op("mean")
+def mean_kernel(ins, attrs):
+    """Parity: mean_op.cc — mean over ALL elements."""
+    return {"Out": jnp.mean(ins["X"])}
+
+
+@register_op("max")
+def max_all_kernel(ins, attrs):
+    return {"Out": jnp.max(ins["X"])}
+
+
+def _unary(fn):
+    def kernel(ins, attrs):
+        return {"Out": fn(ins["X"])}
+
+    return kernel
+
+
+register_op("sqrt")(_unary(jnp.sqrt))
+register_op("rsqrt")(_unary(jax.lax.rsqrt))
+register_op("square")(_unary(jnp.square))
+register_op("exp")(_unary(jnp.exp))
+register_op("log")(_unary(jnp.log))
+register_op("log2")(_unary(jnp.log2))
+register_op("log10")(_unary(jnp.log10))
+register_op("log1p")(_unary(jnp.log1p))
+register_op("abs")(_unary(jnp.abs))
+register_op("sign", no_grad=True)(_unary(jnp.sign))
+register_op("floor", no_grad=True)(_unary(jnp.floor))
+register_op("ceil", no_grad=True)(_unary(jnp.ceil))
+register_op("round", no_grad=True)(_unary(jnp.round))
+register_op("sin")(_unary(jnp.sin))
+register_op("cos")(_unary(jnp.cos))
+register_op("tan")(_unary(jnp.tan))
+register_op("asin")(_unary(jnp.arcsin))
+register_op("acos")(_unary(jnp.arccos))
+register_op("atan")(_unary(jnp.arctan))
+register_op("sinh")(_unary(jnp.sinh))
+register_op("cosh")(_unary(jnp.cosh))
+register_op("reciprocal")(_unary(jnp.reciprocal))
+register_op("logical_not", nondiff_slots=("X",), no_grad=True)(_unary(jnp.logical_not))
+register_op("isnan_v2", nondiff_slots=("X",), no_grad=True)(_unary(jnp.isnan))
+register_op("isinf_v2", nondiff_slots=("X",), no_grad=True)(_unary(jnp.isinf))
+register_op("isfinite_v2", nondiff_slots=("X",), no_grad=True)(_unary(jnp.isfinite))
+
+
+@register_op("clip")
+def clip_kernel(ins, attrs):
+    x = ins["X"]
+    lo = attrs.get("min", float(jnp.finfo(jnp.float32).min))
+    hi = attrs.get("max", float(jnp.finfo(jnp.float32).max))
+    return {"Out": jnp.clip(x, jnp.asarray(lo, x.dtype), jnp.asarray(hi, x.dtype))}
+
+
+def _logical(fn):
+    def kernel(ins, attrs):
+        return {"Out": fn(ins["X"], ins["Y"])}
+
+    return kernel
+
+
+register_op("logical_and", nondiff_slots=("X", "Y"), no_grad=True)(_logical(jnp.logical_and))
+register_op("logical_or", nondiff_slots=("X", "Y"), no_grad=True)(_logical(jnp.logical_or))
+register_op("logical_xor", nondiff_slots=("X", "Y"), no_grad=True)(_logical(jnp.logical_xor))
+
+
+def _compare(fn):
+    def kernel(ins, attrs):
+        x, y = ins["X"], ins["Y"]
+        return {"Out": fn(x, y)}
+
+    return kernel
+
+
+register_op("equal", nondiff_slots=("X", "Y"), no_grad=True)(_compare(jnp.equal))
+register_op("not_equal", nondiff_slots=("X", "Y"), no_grad=True)(_compare(jnp.not_equal))
+register_op("less_than", nondiff_slots=("X", "Y"), no_grad=True)(_compare(jnp.less))
+register_op("less_equal", nondiff_slots=("X", "Y"), no_grad=True)(_compare(jnp.less_equal))
+register_op("greater_than", nondiff_slots=("X", "Y"), no_grad=True)(_compare(jnp.greater))
+register_op("greater_equal", nondiff_slots=("X", "Y"), no_grad=True)(_compare(jnp.greater_equal))
+
+
+@register_op("maximum")
+def maximum_kernel(ins, attrs):
+    return {"Out": jnp.maximum(ins["X"], ins["Y"])}
+
+
+@register_op("minimum")
+def minimum_kernel(ins, attrs):
+    return {"Out": jnp.minimum(ins["X"], ins["Y"])}
+
+
+@register_op("p_norm")
+def p_norm_kernel(ins, attrs):
+    x = ins["X"]
+    porder = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", None)
+    keepdim = attrs.get("keepdim", False)
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    out = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim) ** (1.0 / porder)
+    return {"Out": out}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm_kernel(ins, attrs):
+    return {"Out": jnp.sum(jnp.square(ins["X"])).reshape((1,))}
+
+
+@register_op("cumsum")
+def cumsum_kernel(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": out}
+
+
+@register_op("addmm")
+def addmm_kernel(ins, attrs):
+    inp, x, y = ins["Input"], ins["X"], ins["Y"]
+    alpha = attrs.get("Alpha", 1.0)
+    beta = attrs.get("Beta", 1.0)
+    return {"Out": beta * inp + alpha * jnp.matmul(x, y)}
+
+
+@register_op("dot")
+def dot_kernel(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    return {"Out": jnp.sum(x * y, axis=-1)}

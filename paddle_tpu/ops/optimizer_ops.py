@@ -1,0 +1,222 @@
+"""Optimizer update op kernels.
+
+Parity: ``/root/reference/paddle/fluid/operators/optimizers/`` (53 files:
+sgd_op, momentum_op, adam_op, adamw (via adam+coeff), lamb_op, rmsprop_op,
+adagrad_op, lars_momentum_op).
+
+All are pure functional updates: ``ParamOut = f(Param, Grad, state...)``.
+The executor donates the old buffers to XLA so updates are in-place at the
+HBM level — the functional equivalent of the reference's mutable-scope
+in-place optimizer ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("sgd", no_grad=True)
+def sgd_kernel(ins, attrs):
+    p, g, lr = ins["Param"], ins["Grad"], ins["LearningRate"]
+    return {"ParamOut": p - lr.astype(p.dtype) * g.astype(p.dtype)}
+
+
+@register_op("momentum", no_grad=True)
+def momentum_kernel(ins, attrs):
+    p, g, v, lr = ins["Param"], ins["Grad"], ins["Velocity"], ins["LearningRate"]
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    rd = attrs.get("regularization_coeff", 0.0)
+    if attrs.get("regularization_method", "") == "l2_decay" and rd:
+        g = g + rd * p
+    lr = lr.astype(p.dtype)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("adam", no_grad=True)
+def adam_kernel(ins, attrs):
+    """Parity: adam_op.  Beta pows are carried tensors like the reference."""
+    p, g, lr = ins["Param"], ins["Grad"], ins["LearningRate"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1p, b2p = ins["Beta1Pow"], ins["Beta2Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(m1.dtype)
+    m1o = b1 * m1 + (1.0 - b1) * gf
+    m2o = b2 * m2 + (1.0 - b2) * jnp.square(gf)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    p_out = p - (lr_t * m1o / (jnp.sqrt(m2o) + eps)).astype(p.dtype)
+    return {
+        "ParamOut": p_out,
+        "Moment1Out": m1o,
+        "Moment2Out": m2o,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("adamw", no_grad=True)
+def adamw_kernel(ins, attrs):
+    """AdamW decoupled weight decay (the reference fork lacks fused adamw;
+    its python AdamW scales params before adam — same math)."""
+    coeff = attrs.get("coeff", 0.01)
+    lr_ratio = attrs.get("lr_ratio", 1.0)
+    p, lr = ins["Param"], ins["LearningRate"]
+    with_decay = attrs.get("with_decay", True)
+    if with_decay:
+        p = p * (1.0 - lr * coeff * lr_ratio).astype(p.dtype)
+    ins = dict(ins)
+    ins["Param"] = p
+    return adam_kernel(ins, attrs)
+
+
+@register_op("lamb", no_grad=True)
+def lamb_kernel(ins, attrs):
+    """Parity: lamb_op.cc — layer-adaptive LR for large-batch training."""
+    p, g, lr = ins["Param"], ins["Grad"], ins["LearningRate"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1p, b2p = ins["Beta1Pow"], ins["Beta2Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    gf = g.astype(m1.dtype)
+    m1o = b1 * m1 + (1.0 - b1) * gf
+    m2o = b2 * m2 + (1.0 - b2) * jnp.square(gf)
+    m1h = m1o / (1.0 - b1p)
+    m2h = m2o / (1.0 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * p.astype(m1.dtype)
+    w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+    r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    p_out = p - (ratio * lr * r).astype(p.dtype)
+    return {
+        "ParamOut": p_out,
+        "Moment1Out": m1o,
+        "Moment2Out": m2o,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("rmsprop", no_grad=True)
+def rmsprop_kernel(ins, attrs):
+    p, g, lr = ins["Param"], ins["Grad"], ins["LearningRate"]
+    ms, mom = ins["MeanSquare"], ins["Moment"]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1.0 - rho) * jnp.square(g)
+    if centered:
+        mg = ins["MeanGrad"]
+        mg_out = rho * mg + (1.0 - rho) * g
+        denom = jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
+        mom_out = mu * mom + lr * g / denom
+        return {
+            "ParamOut": p - mom_out,
+            "MeanSquareOut": ms_out,
+            "MomentOut": mom_out,
+            "MeanGradOut": mg_out,
+        }
+    mom_out = mu * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": p - mom_out, "MeanSquareOut": ms_out, "MomentOut": mom_out}
+
+
+@register_op("adagrad", no_grad=True)
+def adagrad_kernel(ins, attrs):
+    p, g, lr, mom = ins["Param"], ins["Grad"], ins["LearningRate"], ins["Moment"]
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + jnp.square(g)
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mom_out) + eps), "MomentOut": mom_out}
+
+
+@register_op("lars_momentum", no_grad=True)
+def lars_momentum_kernel(ins, attrs):
+    """Parity: lars_momentum_op — layer-wise adaptive rate scaling."""
+    p, g, v, lr = ins["Param"], ins["Grad"], ins["Velocity"], ins["LearningRate"]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+    g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+# -- gradient clipping helpers (parity: clip_by_norm_op, used by ClipGradByNorm)
+
+
+@register_op("clip_by_norm")
+def clip_by_norm_kernel(ins, attrs):
+    x = ins["X"]
+    max_norm = attrs.get("max_norm", 1.0)
+    n = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12), 1.0)
+    return {"Out": x * scale.astype(x.dtype)}
+
+
+# -- AMP loss scaling ops (parity: operators/amp/) ---------------------------
+
+
+@register_op("check_finite_and_unscale", list_slots=("X", "Out"), no_grad=True)
+def check_finite_and_unscale_kernel(ins, attrs):
+    """Parity: check_finite_and_unscale_op.cu — unscale grads by 1/loss_scale
+    and flag non-finite values."""
+    xs = ins["X"]
+    scale = ins["Scale"]
+    inv = 1.0 / scale
+    found_inf = jnp.asarray(False)
+    outs = []
+    for x in xs:
+        xf = x.astype(jnp.float32) * inv
+        found_inf = jnp.logical_or(found_inf, jnp.any(~jnp.isfinite(xf)))
+        outs.append(xf.astype(x.dtype))
+    return {"Out": outs, "FoundInfinite": found_inf}
+
+
+@register_op("update_loss_scaling", list_slots=("X", "Out"), no_grad=True)
+def update_loss_scaling_kernel(ins, attrs):
+    """Parity: update_loss_scaling_op.cu — dynamic loss scale state machine."""
+    xs = ins["X"]
+    found_inf = ins["FoundInfinite"]
+    scale = ins["PrevLossScaling"]
+    good = ins["InGoodSteps"]
+    bad = ins["InBadSteps"]
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+    good_out = jnp.where(found_inf, 0, good + 1)
+    bad_out = jnp.where(found_inf, bad + 1, 0)
+    scale_out = jnp.where(
+        found_inf,
+        jnp.where(bad_out >= decr_every, jnp.maximum(scale * decr_ratio, 1.0), scale),
+        jnp.where(good_out >= incr_every, scale * incr_ratio, scale),
+    )
+    bad_out = jnp.where(bad_out >= decr_every, 0, bad_out)
+    good_out = jnp.where(good_out >= incr_every, 0, good_out)
+    outs = [jnp.where(found_inf, jnp.zeros_like(x), x) for x in xs] if attrs.get(
+        "stop_update", False
+    ) is False else list(xs)
+    return {
+        "Out": outs,
+        "LossScaling": scale_out,
+        "OutGoodSteps": good_out,
+        "OutBadSteps": bad_out,
+    }

@@ -1,0 +1,459 @@
+"""Tensor creation / manipulation / indexing op kernels.
+
+Parity: the reference's creation + manipulation op set —
+``fill_constant_op``, ``gaussian_random_op``, ``uniform_random_op``,
+``reshape_op`` (reshape2), ``transpose_op`` (transpose2), ``concat_op``,
+``split_op``, ``slice_op``, ``stack_op``, ``squeeze_op``/``unsqueeze_op``,
+``expand_v2_op``, ``tile_op``, ``gather_op``, ``gather_nd_op``,
+``scatter_op``, ``lookup_table_v2_op`` (embedding), ``one_hot_v2_op``,
+``arg_max_op``, ``top_k_v2_op``, ``where_op``, ``cast_op``, ``assign_op``,
+``tril_triu_op``, ``index_select_op``, ``range_op``, ``shape_op``,
+``fill_any_like_op``, ``flatten_contiguous_range_op``
+(all under ``/root/reference/paddle/fluid/operators/``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import to_jax_dtype
+from .registry import register_op
+
+
+# -- creation ---------------------------------------------------------------
+
+
+@register_op("fill_constant", no_grad=True)
+def fill_constant_kernel(ins, attrs):
+    shape = tuple(attrs.get("shape", ()))
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    if isinstance(value, str):
+        value = float(value)
+    return {"Out": jnp.full(shape, value, dtype=dtype)}
+
+
+@register_op("fill_any_like", nondiff_slots=("X",), no_grad=True)
+def fill_any_like_kernel(ins, attrs):
+    x = ins["X"]
+    dtype = attrs.get("dtype", None)
+    dt = to_jax_dtype(dtype) if dtype not in (None, -1) else x.dtype
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("fill_zeros_like", nondiff_slots=("X",), no_grad=True)
+def fill_zeros_like_kernel(ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"])}
+
+
+@register_op("gaussian_random", needs_rng=True, no_grad=True)
+def gaussian_random_kernel(ins, attrs, rng=None):
+    shape = tuple(attrs.get("shape", ()))
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return {"Out": mean + std * jax.random.normal(rng, shape, dtype=dtype)}
+
+
+@register_op("uniform_random", needs_rng=True, no_grad=True)
+def uniform_random_kernel(ins, attrs, rng=None):
+    shape = tuple(attrs.get("shape", ()))
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    return {"Out": jax.random.uniform(rng, shape, dtype=dtype, minval=lo, maxval=hi)}
+
+
+@register_op("truncated_gaussian_random", needs_rng=True, no_grad=True)
+def truncated_gaussian_random_kernel(ins, attrs, rng=None):
+    shape = tuple(attrs.get("shape", ()))
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return {
+        "Out": mean + std * jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype=dtype)
+    }
+
+
+@register_op("randint", needs_rng=True, no_grad=True)
+def randint_kernel(ins, attrs, rng=None):
+    shape = tuple(attrs.get("shape", ()))
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.randint(rng, shape, attrs.get("low", 0), attrs.get("high", 1)).astype(dtype)}
+
+
+@register_op("randperm", needs_rng=True, no_grad=True)
+def randperm_kernel(ins, attrs, rng=None):
+    n = attrs.get("n")
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.permutation(rng, n).astype(dtype)}
+
+
+@register_op("bernoulli", needs_rng=True, nondiff_slots=("X",), no_grad=True)
+def bernoulli_kernel(ins, attrs, rng=None):
+    x = ins["X"]
+    return {"Out": jax.random.bernoulli(rng, x).astype(x.dtype)}
+
+
+@register_op("range", no_grad=True)
+def range_kernel(ins, attrs):
+    start, end, step = attrs["start"], attrs["end"], attrs["step"]
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jnp.arange(start, end, step, dtype=dtype)}
+
+
+@register_op("eye", no_grad=True)
+def eye_kernel(ins, attrs):
+    r = attrs["num_rows"]
+    c = attrs.get("num_columns", r)
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.eye(r, c, dtype=dtype)}
+
+
+@register_op("linspace", no_grad=True)
+def linspace_kernel(ins, attrs):
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.linspace(attrs["start"], attrs["stop"], attrs["num"], dtype=dtype)}
+
+
+@register_op("assign")
+def assign_kernel(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("shape", nondiff_slots=("Input",), no_grad=True)
+def shape_kernel(ins, attrs):
+    return {"Out": jnp.asarray(ins["Input"].shape, dtype=jnp.int32)}
+
+
+@register_op("cast")
+def cast_kernel(ins, attrs):
+    dtype = to_jax_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    return {"Out": ins["X"].astype(dtype)}
+
+
+# -- shape manipulation -----------------------------------------------------
+
+
+@register_op("reshape2")
+def reshape2_kernel(ins, attrs):
+    x = ins["X"]
+    shape = list(attrs["shape"])
+    # paddle semantics: 0 means copy input dim at that position
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": jnp.reshape(x, shape)}
+
+
+@register_op("transpose2")
+def transpose2_kernel(ins, attrs):
+    return {"Out": jnp.transpose(ins["X"], attrs["axis"])}
+
+
+@register_op("flatten_contiguous_range")
+def flatten_kernel(ins, attrs):
+    x = ins["X"]
+    start = attrs.get("start_axis", 1)
+    stop = attrs.get("stop_axis", -1)
+    start = start % x.ndim
+    stop = stop % x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1 :]
+    return {"Out": jnp.reshape(x, shape)}
+
+
+@register_op("squeeze2")
+def squeeze2_kernel(ins, attrs):
+    x = ins["X"]
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(x)}
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return {"Out": jnp.squeeze(x, axis=axes)}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2_kernel(ins, attrs):
+    x = ins["X"]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("concat", list_slots=("X",))
+def concat_kernel(ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split", list_slots=("Out",))
+def split_kernel(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack", list_slots=("X",))
+def stack_kernel(ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack", list_slots=("Y",))
+def unstack_kernel(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    num = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, num, axis=axis)]}
+
+
+@register_op("expand_v2")
+def expand_v2_kernel(ins, attrs):
+    x = ins["X"]
+    shape = list(attrs["shape"])
+    # -1 means keep input dim
+    xshape = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    x = jnp.reshape(x, xshape)
+    tgt = [xs if s == -1 else s for s, xs in zip(shape, xshape)]
+    return {"Out": jnp.broadcast_to(x, tgt)}
+
+
+@register_op("tile")
+def tile_kernel(ins, attrs):
+    return {"Out": jnp.tile(ins["X"], attrs["repeat_times"])}
+
+
+@register_op("slice")
+def slice_kernel(ins, attrs):
+    x = ins["Input"]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    decrease = attrs.get("decrease_axis", [])
+    if decrease:
+        out = jnp.squeeze(out, axis=tuple(decrease))
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def strided_slice_kernel(ins, attrs):
+    x = ins["Input"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("flip")
+def flip_kernel(ins, attrs):
+    return {"Out": jnp.flip(ins["X"], axis=tuple(attrs["axis"]))}
+
+
+@register_op("roll")
+def roll_kernel(ins, attrs):
+    axis = attrs.get("axis", None)
+    return {"Out": jnp.roll(ins["X"], attrs["shifts"], axis=tuple(axis) if axis else None)}
+
+
+@register_op("pad3d")
+def pad3d_kernel(ins, attrs):
+    x = ins["X"]
+    p = attrs["paddings"]  # [l, r, t, b, f, bk] for NCDHW-ish
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("value", 0.0)
+    pads = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pads, constant_values=value)}
+    return {"Out": jnp.pad(x, pads, mode={"reflect": "reflect", "replicate": "edge"}[mode])}
+
+
+@register_op("pad")
+def pad_kernel(ins, attrs):
+    x = ins["X"]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("tril_triu")
+def tril_triu_kernel(ins, attrs):
+    x = ins["X"]
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(x, diag)}
+    return {"Out": jnp.triu(x, diag)}
+
+
+# -- indexing ---------------------------------------------------------------
+
+
+@register_op("lookup_table_v2", nondiff_slots=("Ids",))
+def lookup_table_v2_kernel(ins, attrs):
+    """Embedding lookup. Parity: lookup_table_v2_op.  The vjp of jnp.take is a
+    scatter-add — XLA's native embedding gradient on TPU."""
+    w, ids = ins["W"], ins["Ids"]
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return {"Out": out}
+
+
+@register_op("gather", nondiff_slots=("Index",))
+def gather_kernel(ins, attrs):
+    x, index = ins["X"], ins["Index"]
+    axis = attrs.get("axis", 0)
+    return {"Out": jnp.take(x, index, axis=axis)}
+
+
+@register_op("gather_nd", nondiff_slots=("Index",))
+def gather_nd_kernel(ins, attrs):
+    x, index = ins["X"], ins["Index"]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": x[idx]}
+
+
+@register_op("scatter", nondiff_slots=("Ids",))
+def scatter_kernel(ins, attrs):
+    x, ids, updates = ins["X"], ins["Ids"], ins["Updates"]
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(updates)}
+    return {"Out": x.at[ids].add(updates)}
+
+
+@register_op("scatter_nd_add", nondiff_slots=("Index",))
+def scatter_nd_add_kernel(ins, attrs):
+    x, index, updates = ins["X"], ins["Index"], ins["Updates"]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": x.at[idx].add(updates)}
+
+
+@register_op("index_select", nondiff_slots=("Index",))
+def index_select_kernel(ins, attrs):
+    return {"Out": jnp.take(ins["X"], ins["Index"], axis=attrs.get("dim", 0))}
+
+
+@register_op("where", nondiff_slots=("Condition",))
+def where_kernel(ins, attrs):
+    return {"Out": jnp.where(ins["Condition"], ins["X"], ins["Y"])}
+
+
+@register_op("where_index", nondiff_slots=("Condition",), no_grad=True)
+def where_index_kernel(ins, attrs):
+    # nonzero with static size unsupported under jit; eager-only helper
+    import numpy as np
+
+    return {"Out": jnp.asarray(np.argwhere(np.asarray(ins["Condition"])))}
+
+
+@register_op("masked_select", nondiff_slots=("Mask",), no_grad=True)
+def masked_select_kernel(ins, attrs):
+    import numpy as np
+
+    x, m = np.asarray(ins["X"]), np.asarray(ins["Mask"])
+    return {"Y": jnp.asarray(x[m])}
+
+
+@register_op("one_hot_v2", nondiff_slots=("X",), no_grad=True)
+def one_hot_v2_kernel(ins, attrs):
+    depth = attrs["depth"]
+    return {"Out": jax.nn.one_hot(ins["X"], depth, dtype=jnp.float32)}
+
+
+@register_op("arg_max", nondiff_slots=("X",), no_grad=True)
+def arg_max_kernel(ins, attrs):
+    x = ins["X"]
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    if attrs.get("flatten", False):
+        out = jnp.argmax(jnp.reshape(x, (-1,)))
+    else:
+        out = jnp.argmax(x, axis=attrs.get("axis", -1))
+        if attrs.get("keepdims", False):
+            out = jnp.expand_dims(out, attrs.get("axis", -1))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("arg_min", nondiff_slots=("X",), no_grad=True)
+def arg_min_kernel(ins, attrs):
+    axis = attrs.get("axis", -1)
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jnp.argmin(ins["X"], axis=axis).astype(dtype)}
+
+
+@register_op("argsort", nondiff_slots=("X",), no_grad=True)
+def argsort_kernel(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2", nondiff_out_slots=("Indices",))
+def top_k_v2_kernel(ins, attrs):
+    x = ins["X"]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", True)
+    x_moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(x_moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-x_moved, k)
+        vals = -vals
+    return {
+        "Out": jnp.moveaxis(vals, -1, axis),
+        "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64),
+    }
+
+
+@register_op("unique", nondiff_slots=("X",), no_grad=True)
+def unique_kernel(ins, attrs):
+    import numpy as np
+
+    x = np.asarray(ins["X"])
+    out, index, inverse, counts = np.unique(
+        x, return_index=True, return_inverse=True, return_counts=True
+    )
+    return {
+        "Out": jnp.asarray(out),
+        "Index": jnp.asarray(index.astype("int64")),
+        "Indices": jnp.asarray(inverse.astype("int64")),
+        "Counts": jnp.asarray(counts.astype("int64")),
+    }
+
+
+@register_op("take_along_axis", nondiff_slots=("Index",))
+def take_along_axis_kernel(ins, attrs):
+    return {
+        "Result": jnp.take_along_axis(ins["Input"], ins["Index"], axis=attrs.get("Axis", 0))
+    }
+
+
+@register_op("meshgrid", list_slots=("X", "Out"))
+def meshgrid_kernel(ins, attrs):
+    return {"Out": list(jnp.meshgrid(*ins["X"], indexing="ij"))}
+
+
+@register_op("broadcast_to")
+def broadcast_to_kernel(ins, attrs):
+    return {"Out": jnp.broadcast_to(ins["X"], attrs["shape"])}

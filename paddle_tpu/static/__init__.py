@@ -1,0 +1,17 @@
+"""``paddle.static`` equivalent: Program construction + Executor + autodiff.
+
+Parity: ``/root/reference/python/paddle/static/`` plus the executor/backward
+halves of ``python/paddle/fluid/``.
+"""
+
+from ..framework.program import (  # noqa: F401
+    Program,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+)
+from .backward import append_backward, gradients  # noqa: F401
+from .executor import CompiledProgram, Executor  # noqa: F401
+from .io import load, load_inference_model, save, save_inference_model  # noqa: F401
+from .input import data, InputSpec  # noqa: F401

@@ -1,0 +1,190 @@
+"""Static-graph reverse-mode autodiff: ``append_backward`` / ``gradients``.
+
+Parity: ``/root/reference/python/paddle/fluid/backward.py`` —
+``append_backward``:1377 (grad-op expansion via ``core.get_grad_op_desc``
+:1085, duplicate-grad accumulation ``_addup_repetitive_outputs_``, no-grad
+pruning) — with the per-op grad descs coming from the op registry's grad
+makers (auto-``jax.vjp`` by default, see ``ops/registry.py``).
+
+The emitted grad ops are ordinary registry ops appended to the same block, so
+the executor compiles forward+backward+optimizer into one XLA computation;
+recomputation inside auto-vjp grad ops is CSE'd/rematerialized by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..framework import program as fw
+from ..framework.dtype import is_floating
+from ..framework.program import GRAD_SUFFIX, grad_var_name
+from ..ops import registry
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _collect_no_grad(block: fw.Block, no_grad_set: Optional[Set[str]]) -> Set[str]:
+    out = set(no_grad_set or ())
+    for var in block.vars.values():
+        if var.stop_gradient or not is_floating(var.dtype):
+            out.add(var.name)
+    parent = block.parent_block
+    while parent is not None:
+        for var in parent.vars.values():
+            if var.stop_gradient or not is_floating(var.dtype):
+                out.add(var.name)
+        parent = parent.parent_block
+    return out
+
+
+def _ensure_grad_var(block: fw.Block, fwd_name: str, grad_name: str):
+    if block._has_var_recursive(grad_name):
+        return block._var_recursive(grad_name)
+    try:
+        fwd = block._var_recursive(fwd_name)
+        shape, dtype = fwd.shape, fwd.dtype
+    except ValueError:
+        shape, dtype = (), "float32"
+    return block.create_var(name=grad_name, shape=shape, dtype=dtype, stop_gradient=True)
+
+
+def append_backward(
+    loss: fw.Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    callbacks=None,
+):
+    """Append grad ops for ``loss`` to its block; returns [(param, grad)].
+
+    Parity: ``backward.py:1377``.
+    """
+    block = loss.block
+    program = block.program
+    no_grad = _collect_no_grad(block, no_grad_set)
+
+    loss_grad_name = grad_var_name(loss.name)
+    block.append_op(
+        type="fill_any_like",
+        inputs={"X": [loss.name]},
+        outputs={"Out": [loss_grad_name]},
+        attrs={"value": 1.0, "dtype": -1},
+    )
+    _ensure_grad_var(block, loss.name, loss_grad_name)
+
+    fwd_ops = [
+        op
+        for op in block.ops
+        if not op.type.endswith("_grad") and op.type != "fill_any_like"
+    ]
+
+    produced_grads: Set[str] = {loss_grad_name}
+    rename_counter = 0
+
+    for op in reversed(fwd_ops):
+        op_def_known = registry.is_registered(op.type)
+        if not op_def_known:
+            continue
+        op_def = registry.get_op_def(op.type)
+        if op_def.no_grad:
+            continue
+        out_grad_names = [
+            grad_var_name(n)
+            for slot, names in op.outputs.items()
+            if slot not in op_def.nondiff_out_slots
+            for n in names
+        ]
+        if not any(g in produced_grads for g in out_grad_names):
+            continue
+        # outputs with no incoming grad get explicit zeros (parity:
+        # fill_zeros_like insertion in the reference's backward pass)
+        for slot, names in op.outputs.items():
+            if slot in op_def.nondiff_out_slots:
+                continue
+            for n in names:
+                g = grad_var_name(n)
+                if g not in produced_grads:
+                    block.append_op(
+                        type="fill_zeros_like",
+                        inputs={"X": [n]},
+                        outputs={"Out": [g]},
+                        attrs={},
+                    )
+                    _ensure_grad_var(block, n, g)
+                    produced_grads.add(g)
+
+        grad_op_descs = registry.make_grad_op_descs(op, no_grad)
+        for gop in grad_op_descs:
+            final_outputs: Dict[str, List[str]] = {}
+            accumulations = []  # (existing_name, temp_name)
+            for slot, names in gop["outputs"].items():
+                outs = []
+                for n in names:
+                    if not n:
+                        outs.append("")
+                        continue
+                    if n in produced_grads:
+                        rename_counter += 1
+                        tmp = f"{n}@RENAME@{rename_counter}"
+                        accumulations.append((n, tmp))
+                        outs.append(tmp)
+                    else:
+                        outs.append(n)
+                final_outputs[slot] = outs
+            block.append_op(
+                type=gop["type"],
+                inputs=gop["inputs"],
+                outputs=final_outputs,
+                attrs=gop["attrs"],
+            )
+            for slot, names in final_outputs.items():
+                for n in names:
+                    if n:
+                        base = n.split("@RENAME@")[0]
+                        _ensure_grad_var(block, base[: -len(GRAD_SUFFIX)], n)
+                        produced_grads.add(base)
+            # accumulate duplicate grads: new = old + tmp, rebinding the
+            # original name (parity: _addup_repetitive_outputs_)
+            for orig, tmp in accumulations:
+                block.append_op(
+                    type="sum",
+                    inputs={"X": [orig, tmp]},
+                    outputs={"Out": [orig]},
+                    attrs={},
+                )
+
+    # collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [
+            p if isinstance(p, fw.Variable) else block._var_recursive(str(p))
+            for p in parameter_list
+        ]
+    else:
+        params = program.all_parameters()
+    result = []
+    for p in params:
+        if not getattr(p, "trainable", True) or p.name in no_grad:
+            continue
+        gname = grad_var_name(p.name)
+        if block._has_var_recursive(gname):
+            result.append((p, block._var_recursive(gname)))
+    return result
+
+
+def gradients(
+    targets,
+    inputs,
+    target_gradients=None,
+    no_grad_set: Optional[Set[str]] = None,
+) -> List[fw.Variable]:
+    """Parity: ``backward.py:1972`` ``paddle.static.gradients``."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert len(targets) == 1, "gradients() currently supports a single target"
+    target = targets[0]
+    append_backward(target, no_grad_set=no_grad_set)
+    block = target.block
+    outs = []
+    for v in inputs:
+        gname = grad_var_name(v.name)
+        outs.append(block._var_recursive(gname) if block._has_var_recursive(gname) else None)
+    return outs

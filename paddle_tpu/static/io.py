@@ -1,0 +1,91 @@
+"""Static model save/load.
+
+Parity: ``/root/reference/python/paddle/fluid/io.py`` (``save_persistables``
+:668, ``save_inference_model``:1246, ``load_inference_model``:1459,
+``save``:1840, ``load_program_state``:2144) and ``python/paddle/static/io.py``.
+
+Format: program structure as JSON (Program.to_dict), parameters as an ``.npz``
+of numpy arrays — a portable, XLA-independent serialization replacing the
+reference's protobuf + raw LoDTensor byte streams.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework import program as fw
+from ..framework.scope import global_scope
+
+
+def _state_arrays(program: fw.Program, scope) -> dict:
+    out = {}
+    for var in program.list_vars():
+        if not var.persistable:
+            continue
+        val = scope.find_var(var.name)
+        if val is not None:
+            out[var.name] = np.asarray(val)
+    return out
+
+
+def save(program: fw.Program, model_path: str, scope=None):
+    """Parity: ``fluid.io.save`` / ``paddle.static.save``."""
+    scope = scope or global_scope()
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdmodel.json", "w") as f:
+        json.dump(program.to_dict(), f)
+    np.savez(model_path + ".pdparams.npz", **_state_arrays(program, scope))
+
+
+def load(program: fw.Program, model_path: str, executor=None, scope=None):
+    """Parity: ``fluid.io.load`` — restores persistables into the scope."""
+    import jax.numpy as jnp
+
+    scope = scope or global_scope()
+    data = np.load(model_path + ".pdparams.npz", allow_pickle=False)
+    for name in data.files:
+        scope.set(name, jnp.asarray(data[name]))
+
+
+def save_inference_model(
+    path_prefix: str,
+    feed_vars: List[fw.Variable],
+    fetch_vars: List[fw.Variable],
+    executor=None,
+    program: Optional[fw.Program] = None,
+    scope=None,
+):
+    """Parity: ``fluid.io.save_inference_model``:1246 — saves an inference
+    program (cloned for test) + persistables."""
+    program = program or fw.default_main_program()
+    infer_prog = program.clone(for_test=True)
+    meta = {
+        "program": infer_prog.to_dict(),
+        "feed_names": [v.name for v in feed_vars],
+        "fetch_names": [v.name for v in fetch_vars],
+    }
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel.json", "w") as f:
+        json.dump(meta, f)
+    np.savez(path_prefix + ".pdparams.npz", **_state_arrays(program, scope or global_scope()))
+
+
+def load_inference_model(path_prefix: str, executor=None, scope=None):
+    """Parity: ``fluid.io.load_inference_model``:1459.
+
+    Returns (program, feed_names, fetch_names) with persistables loaded.
+    """
+    import jax.numpy as jnp
+
+    scope = scope or global_scope()
+    with open(path_prefix + ".pdmodel.json") as f:
+        meta = json.load(f)
+    program = fw.Program.from_dict(meta["program"])
+    data = np.load(path_prefix + ".pdparams.npz", allow_pickle=False)
+    for name in data.files:
+        scope.set(name, jnp.asarray(data[name]))
+    return program, meta["feed_names"], meta["fetch_names"]
