@@ -1,0 +1,12 @@
+"""Dygraph (eager) engine: Tensor over jax.Array + tape autograd.
+
+Capability parity with the reference's imperative engine
+(`/root/reference/paddle/fluid/imperative/` — `Tracer::TraceOp` tracer.cc:144,
+`VarBase` layer.h:66, `BasicEngine::Execute` basic_engine.cc:305), built
+TPU-first: every eager op runs through a jit-cached XLA executable keyed by
+(op, attrs, shapes) instead of a per-op CUDA kernel dispatch.
+"""
+
+from .tensor import Tensor, to_tensor  # noqa: F401
+from .base import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from . import tracer  # noqa: F401

@@ -1,0 +1,52 @@
+"""Test configuration: force a virtual 8-device CPU platform BEFORE jax init.
+
+Mirrors the reference's strategy of testing distributed code on localhost
+subprocesses (SURVEY.md §4, test_dist_base.py): here multi-chip behavior is
+tested on a single host via XLA's virtual CPU devices, so every sharding /
+collective path compiles and runs without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # numeric parity tests need fp32 CPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The image's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon (the TPU tunnel), so jax's config snapshot ignores the
+# env override above — force it through the live config instead.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope (static-graph hygiene)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import program as fw
+    from paddle_tpu.framework import scope as sc
+    from paddle_tpu.framework import unique_name
+
+    old_main, old_startup = fw._main_program_, fw._startup_program_
+    fw._main_program_ = fw.Program()
+    fw._startup_program_ = fw.Program()
+    fw._startup_program_._is_start_up_program = True
+    old_scope = sc._global_scope
+    sc._global_scope = sc.Scope()
+    with unique_name.guard():
+        yield
+    fw._main_program_, fw._startup_program_ = old_main, old_startup
+    sc._global_scope = old_scope
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

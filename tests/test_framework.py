@@ -1,0 +1,100 @@
+"""Core Program/Block/Variable tests (parity role: reference's
+test_program.py / test_operator_desc.py / test_variable.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import program as fw
+
+
+def test_program_block_structure():
+    prog = fw.Program()
+    b0 = prog.global_block()
+    assert b0.idx == 0 and b0.parent_idx == -1
+    b1 = prog._create_block()
+    assert b1.idx == 1 and b1.parent_idx == 0
+    assert prog.current_block() is b1
+    prog._rollback()
+    assert prog.current_block() is b0
+
+
+def test_variable_creation_and_lookup():
+    prog = fw.Program()
+    blk = prog.global_block()
+    v = blk.create_var(name="x", shape=(2, 3), dtype="float32")
+    assert blk.var("x") is v
+    assert v.shape == (2, 3) and v.dtype == "float32"
+    sub = prog._create_block()
+    assert sub._var_recursive("x") is v
+    with pytest.raises(ValueError):
+        blk.var("nope")
+
+
+def test_parameter_lives_in_global_block():
+    prog = fw.Program()
+    sub = prog._create_block()
+    p = sub.create_parameter(shape=(4,), dtype="float32", name="w")
+    assert "w" in prog.global_block().vars
+    assert p.persistable and p.trainable
+    assert prog.all_parameters() == [p]
+
+
+def test_append_op_infers_shapes():
+    prog = fw.Program()
+    with fw.program_guard(prog):
+        blk = prog.global_block()
+        x = blk.create_var(name="x", shape=(2, 3), dtype="float32")
+        y = blk.create_var(name="y", shape=(3, 4), dtype="float32")
+        out = blk.create_var(name="out")
+        blk.append_op(
+            type="matmul_v2",
+            inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]},
+            attrs={},
+        )
+    assert out.shape == (2, 4)
+    assert out.dtype == "float32"
+
+
+def test_dynamic_batch_dim_propagates():
+    prog = fw.Program()
+    with fw.program_guard(prog):
+        blk = prog.global_block()
+        x = blk.create_var(name="x", shape=(-1, 3), dtype="float32")
+        y = blk.create_var(name="y", shape=(3, 4), dtype="float32")
+        out = blk.create_var(name="out")
+        blk.append_op(
+            type="matmul_v2", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]}, attrs={}
+        )
+    assert out.shape == (-1, 4)
+
+
+def test_program_clone_and_serialization_roundtrip():
+    prog = fw.Program()
+    with fw.program_guard(prog):
+        blk = prog.global_block()
+        x = blk.create_var(name="x", shape=(2, 2), dtype="float32")
+        out = blk.create_var(name="out")
+        blk.append_op(type="relu", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={})
+    clone = prog.clone()
+    assert len(clone.global_block().ops) == 1
+    assert clone.global_block().ops[0].type == "relu"
+    d = prog.to_dict()
+    back = fw.Program.from_dict(d)
+    assert [op.type for op in back.global_block().ops] == ["relu"]
+    assert back.global_block().var("x").shape == (2, 2)
+
+
+def test_program_guard_switches_defaults():
+    prog = fw.Program()
+    with fw.program_guard(prog):
+        assert fw.default_main_program() is prog
+    assert fw.default_main_program() is not prog
+
+
+def test_enable_disable_static():
+    paddle.enable_static()
+    assert not fw.in_dygraph_mode()
+    paddle.disable_static()
+    assert fw.in_dygraph_mode()

@@ -1,0 +1,308 @@
+"""Per-op numeric parity + grad checks through the OpTest harness.
+
+Covers the op families the BASELINE configs use (SURVEY.md §7 layer 2):
+elementwise/math/reduce/matmul/conv/norm/activation/softmax-xent/embedding.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test(self, rng):
+        self.setup(rng)
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def test(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmulV2(OpTest):
+    op_type = "matmul_v2"
+
+    def test(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(2, 4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul_v2"
+
+    def test(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"trans_y": True}
+        self.outputs = {"Out": x @ y.T}
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test(self, rng):
+        x = rng.randn(4, 7).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": _softmax(x)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestReduceMean(OpTest):
+    op_type = "reduce_mean"
+
+    def test(self, rng):
+        x = rng.randn(3, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": x.mean(axis=1)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestReduceSumAll(OpTest):
+    op_type = "reduce_sum"
+
+    def test(self, rng):
+        x = rng.randn(3, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": x.sum()}
+        self.check_output()
+
+
+class TestRelu(OpTest):
+    op_type = "relu"
+
+    def test(self, rng):
+        x = rng.randn(4, 4).astype("float32")
+        x[np.abs(x) < 0.05] = 0.2  # keep away from kink for fd grad
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestGelu(OpTest):
+    op_type = "gelu"
+
+    def test(self, rng):
+        x = rng.randn(3, 3).astype("float32")
+        self.inputs = {"X": x}
+        import math
+
+        ref = np.array(
+            [0.5 * v * (1 + math.erf(v / math.sqrt(2))) for v in x.reshape(-1)],
+            dtype="float32",
+        ).reshape(x.shape)
+        self.outputs = {"Out": ref}
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"])
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test(self, rng):
+        x = rng.randn(4, 6).astype("float32")
+        scale = rng.randn(6).astype("float32")
+        bias = rng.randn(6).astype("float32")
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        self.outputs = {"Y": y}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Scale", "Bias"], output_name="Y", atol=1e-2, rtol=1e-2)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self, rng):
+        logits = rng.randn(5, 7).astype("float32")
+        labels = rng.randint(0, 7, size=(5, 1)).astype("int64")
+        sm = _softmax(logits)
+        loss = -np.log(sm[np.arange(5), labels[:, 0]])[:, None]
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-5)
+        self.check_grad(["Logits"], output_name="Loss")
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def test(self, rng):
+        x = rng.randn(1, 2, 5, 5).astype("float32")
+        w = rng.randn(3, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1], "groups": 1, "dilations": [1, 1]}
+        import jax
+
+        ref = np.asarray(
+            jax.lax.conv_general_dilated(
+                x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)]
+            )
+        )
+        self.outputs = {"Output": ref}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Filter"], output_name="Output", atol=1e-2, rtol=1e-2)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def test(self, rng):
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {
+            "pooling_type": "max",
+            "ksize": [2, 2],
+            "strides": [2, 2],
+            "paddings": [0, 0],
+        }
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": ref}
+        self.check_output()
+
+
+class TestLookupTableV2(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test(self, rng):
+        table = rng.randn(10, 4).astype("float32")
+        ids = np.array([1, 3, 5], dtype="int64")
+        self.inputs = {"W": table, "Ids": ids}
+        self.outputs = {"Out": table[ids]}
+        self.check_output()
+        self.check_grad(["W"])
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def test(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [0, 2, 1]}
+        self.outputs = {"Out": x.transpose(0, 2, 1)}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestReshape(OpTest):
+    op_type = "reshape2"
+
+    def test(self, rng):
+        x = rng.randn(2, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [4, 3]}
+        self.outputs = {"Out": x.reshape(4, 3)}
+        self.check_output()
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def test(self, rng):
+        a = rng.randn(2, 3).astype("float32")
+        b = rng.randn(2, 5).astype("float32")
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.check_output()
+
+
+class TestBatchNormInference(OpTest):
+    op_type = "batch_norm"
+
+    def test(self, rng):
+        x = rng.randn(4, 3, 2, 2).astype("float32")
+        scale = rng.rand(3).astype("float32") + 0.5
+        bias = rng.randn(3).astype("float32")
+        mean = rng.randn(3).astype("float32")
+        var = rng.rand(3).astype("float32") + 0.5
+        y = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5
+        ) * scale[None, :, None, None] + bias[None, :, None, None]
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var}
+        self.attrs = {"epsilon": 1e-5, "momentum": 0.9, "is_test": True}
+        self.outputs = {"Y": y}
+        self.check_output(atol=1e-4)
+
+
+class TestDropoutEval(OpTest):
+    op_type = "dropout"
+
+    def test(self, rng):
+        x = rng.randn(4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.5, "is_test": True}
+        self.outputs = {"Out": x}
+        self.check_output()
+
+
+class TestSigmoid(OpTest):
+    op_type = "sigmoid"
+
+    def test(self, rng):
+        x = rng.randn(3, 3).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test(self, rng):
+        x = rng.randn(3, 3).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": 2.5 * x + 1.0}
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestMeanOp(OpTest):
+    op_type = "mean"
+
+    def test(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.mean()}
+        self.check_output()
+        self.check_grad(["X"])
