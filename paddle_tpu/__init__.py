@@ -12,6 +12,13 @@ Architecture (TPU-first, see SURVEY.md §7):
   XLA collectives (distributed/); hot kernels in Pallas (kernels/).
 """
 
+import jax as _jax
+
+# int64/float64 parity with the reference API (ids are int64 in paddle).
+# Compute-path dtypes are managed explicitly (float32/bfloat16 everywhere);
+# python-float data is still downcast to float32 at Tensor creation.
+_jax.config.update("jax_enable_x64", True)
+
 from . import framework  # noqa: F401
 from .framework import (  # noqa: F401
     CPUPlace,
@@ -69,13 +76,27 @@ for _mod in (
     except ImportError:
         pass
 
+from .dygraph.tensor import Tensor, to_tensor  # noqa: F401
+from .dygraph.base import (  # noqa: F401
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from .tensor_api import *  # noqa: F401,F403
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+# Surfaces that land later in the build keep their own granular guards.
 try:
-    from .dygraph.tensor import Tensor, to_tensor  # noqa: F401
-    from .dygraph.base import grad, no_grad  # noqa: F401
-    from .tensor_api import *  # noqa: F401,F403
     from .io_api import load, save  # noqa: F401
-    from .framework.random import seed  # noqa: F401
+except ImportError:
+    pass
+try:
     from .hapi import Model  # noqa: F401
+except ImportError:
+    pass
+try:
     from .dygraph.parallel import DataParallel  # noqa: F401
 except ImportError:
     pass

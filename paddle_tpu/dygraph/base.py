@@ -1,0 +1,93 @@
+"""Dygraph autograd context managers + ``paddle.grad``.
+
+Parity: ``fluid/dygraph/base.py`` (``no_grad``:89 area, ``grad``), and
+``paddle/autograd/backward_mode.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+from . import tracer
+from .engine import calc_gradient
+
+
+def is_grad_enabled() -> bool:
+    return tracer.has_grad()
+
+
+def set_grad_enabled(flag: bool):
+    @contextlib.contextmanager
+    def guard():
+        old = tracer.set_grad_enabled(flag)
+        try:
+            yield
+        finally:
+            tracer.set_grad_enabled(old)
+
+    return guard()
+
+
+class no_grad:
+    """Usable as decorator or context manager (parity: paddle.no_grad)."""
+
+    def __enter__(self):
+        self._old = tracer.set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        tracer.set_grad_enabled(self._old)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._old = tracer.set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        tracer.set_grad_enabled(self._old)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with enable_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """Parity: ``paddle.grad`` (autograd/backward_mode.py + partial_grad_engine)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    return calc_gradient(
+        outputs,
+        inputs,
+        grad_outputs=grad_outputs,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        allow_unused=allow_unused,
+        no_grad_vars=no_grad_vars,
+    )

@@ -1,0 +1,310 @@
+"""Backward engine: topo-ordered tape replay.
+
+Parity: ``BasicEngine::Execute``
+(`/root/reference/paddle/fluid/imperative/basic_engine.cc:305`) — queue over
+grad nodes with gradient accumulation (GradientAccumulator), and
+``partial_grad_engine.cc`` for ``paddle.grad``.  Grad kernels are the same
+registry auto-vjp/grad-maker ops the static path uses, executed through the
+tracer's jit cache (and re-taped when ``create_graph=True`` — double grad).
+
+Gradients are keyed by tensor IDENTITY (id()), matching the reference's
+per-VarBase accumulators — names are only used to wire grad-op descs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import registry
+from . import tracer
+
+
+def _collect_records(roots) -> List:
+    """All tape nodes reachable from roots, newest-first (seq desc)."""
+    seen = set()
+    stack = [t.grad_node for t in roots if t.grad_node is not None]
+    out = []
+    while stack:
+        rec = stack.pop()
+        if id(rec) in seen:
+            continue
+        seen.add(id(rec))
+        out.append(rec)
+        if isinstance(rec, tracer.PyFuncRecord):
+            ins = rec.inputs_list
+        else:
+            ins = [t for ts in rec.inputs.values() for t in ts]
+        for t in ins:
+            if t.grad_node is not None and id(t.grad_node) not in seen:
+                stack.append(t.grad_node)
+    out.sort(key=lambda r: r.seq, reverse=True)
+    return out
+
+
+def _accum(grad_map: Dict[int, object], tensor, g):
+    from .tensor import Tensor
+
+    key = id(tensor)
+    old = grad_map.get(key)
+    if old is None:
+        grad_map[key] = g
+    elif isinstance(old, Tensor) or isinstance(g, Tensor):
+        # create_graph path: stay on the tape through Tensor arithmetic
+        old_t = old if isinstance(old, Tensor) else Tensor(old)
+        g_t = g if isinstance(g, Tensor) else Tensor(g)
+        grad_map[key] = old_t + g_t
+    else:
+        grad_map[key] = old + g
+
+
+def _get_grad(grad_map, tensor):
+    return grad_map.get(id(tensor))
+
+
+def _run_record_backward(
+    rec, grad_map: Dict[int, object], create_graph: bool, no_grad_ids: Set[int]
+):
+    """Compute input grads for one tape node and accumulate."""
+    from .tensor import Tensor
+
+    if isinstance(rec, tracer.PyFuncRecord):
+        outs = rec.outputs_list
+        if create_graph:
+            n_in = len(rec.inputs_list)
+            ct_tensors = []
+            for t in outs:
+                g = _get_grad(grad_map, t)
+                if g is None:
+                    ct_tensors.append(
+                        Tensor(jnp.zeros(t._array.shape, t._array.dtype), stop_gradient=True)
+                    )
+                elif isinstance(g, Tensor):
+                    ct_tensors.append(g)
+                else:
+                    ct_tensors.append(Tensor(g, stop_gradient=True))
+
+            def _bfn(*arrays, _fn=rec.fn, _n=n_in, _single=rec.single):
+                prim, cts = arrays[:_n], arrays[_n:]
+                _, vjp_fn = jax.vjp(_fn, *prim)
+                return vjp_fn(cts[0] if _single else tuple(cts))
+
+            grads = tracer.trace_fn(_bfn, list(rec.inputs_list) + ct_tensors, name="pyfunc_grad")
+            if not isinstance(grads, (list, tuple)):
+                grads = [grads]
+            for t, g in zip(rec.inputs_list, grads):
+                if not t.stop_gradient and id(t) not in no_grad_ids and g is not None:
+                    _accum(grad_map, t, g)
+            return
+        arrays = [t._array for t in rec.inputs_list]
+        _, vjp_fn = jax.vjp(rec.fn, *arrays)
+        cts = []
+        for t in outs:
+            g = _get_grad(grad_map, t)
+            if g is None:
+                g = jnp.zeros(t._array.shape, t._array.dtype)
+            elif isinstance(g, Tensor):
+                g = g._array
+            cts.append(jnp.asarray(g, t._array.dtype))
+        in_grads = vjp_fn(cts[0] if rec.single else tuple(cts))
+        for t, g in zip(rec.inputs_list, in_grads):
+            if not t.stop_gradient and id(t) not in no_grad_ids and g is not None:
+                _accum(grad_map, t, g)
+        return
+
+    op_def = registry.get_op_def(rec.type)
+    grad_descs = registry.make_grad_op_descs(rec, set())
+    # name -> Tensor env from the record's tensors (originals — tape intact).
+    # Names are unique within one record's op desc by construction.
+    env: Dict[str, Tensor] = {}
+    for ts in list(rec.inputs.values()) + list(rec.outputs.values()):
+        for t in ts:
+            env[t.name] = t
+    for gop in grad_descs:
+        ins_t: Dict[str, List[Tensor]] = {}
+        missing_out_grad = False
+        for slot, names in gop["inputs"].items():
+            vals = []
+            for n in names:
+                if n.endswith(registry.GRAD_SUFFIX):
+                    base = n[: -len(registry.GRAD_SUFFIX)]
+                    ref = env.get(base)
+                    if ref is None:
+                        missing_out_grad = True
+                        break
+                    g = _get_grad(grad_map, ref)
+                    if g is None:
+                        # zero-fill missing output grads (parity: the
+                        # reference's fill_zeros_like insertion)
+                        g = jnp.zeros(ref._array.shape, ref._array.dtype)
+                    if not isinstance(g, Tensor):
+                        g = Tensor(g, stop_gradient=True)
+                    vals.append(g)
+                else:
+                    vals.append(env[n])
+            if missing_out_grad:
+                break
+            if vals or slot in op_def.list_slots:
+                ins_t[slot] = vals
+        if missing_out_grad:
+            continue
+        grad_def = registry.get_op_def(gop["type"])
+        attrs = gop["attrs"]
+        if create_graph:
+            # run the grad kernel through trace_fn so grad-of-grad is taped
+            # (vjp-of-vjp; works to arbitrary order)
+            order = [(slot, i) for slot, vals in ins_t.items() for i in range(len(vals))]
+            tensors = [ins_t[s][i] for s, i in order]
+            out_slots = list(gop["outputs"])
+
+            def _fn(*arrays, _order=order, _attrs=attrs, _gd=grad_def, _rng=rec.rng, _os=out_slots):
+                kins: Dict[str, List] = {}
+                for (s, _), a in zip(_order, arrays):
+                    kins.setdefault(s, []).append(a)
+                res = registry.run_kernel(_gd, kins, _attrs, rng=_rng)
+                return tuple(v for s in _os for v in res.get(s, []))
+
+            flat = tracer.trace_fn(_fn, tensors, name=gop["type"])
+            if not isinstance(flat, (list, tuple)):
+                flat = [flat]
+            outs = {}
+            k = 0
+            for s in out_slots:
+                n_out = len(gop["outputs"][s])
+                outs[s] = flat[k : k + n_out]
+                k += n_out
+        else:
+            ins = {s: [t._array for t in vals] for s, vals in ins_t.items()}
+            outs = tracer.run_eager_kernel(gop["type"], ins, attrs, rng=rec.rng)
+        for slot, names in gop["outputs"].items():
+            vals = outs.get(slot, [])
+            for n, g in zip(names, vals):
+                if not n or g is None:
+                    continue
+                base = n[: -len(registry.GRAD_SUFFIX)]
+                tgt = env.get(base)
+                if tgt is None or tgt.stop_gradient or id(tgt) in no_grad_ids:
+                    continue
+                _accum(grad_map, tgt, g)
+
+
+def _seed_roots(roots, grad_tensors, grad_map):
+    from .tensor import Tensor
+
+    for i, t in enumerate(roots):
+        g = None if grad_tensors is None else grad_tensors[i]
+        if g is None:
+            g = jnp.ones(t._array.shape, t._array.dtype)
+        else:
+            g = g._array if isinstance(g, Tensor) else jnp.asarray(g)
+        _accum(grad_map, t, g)
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Optional[Sequence] = None,
+    retain_graph: bool = False,
+):
+    """``Tensor.backward()`` entry — writes ``.grad`` on leaf tensors."""
+    from .tensor import Tensor
+
+    roots = list(tensors)
+    grad_map: Dict[int, object] = {}
+    _seed_roots(roots, grad_tensors, grad_map)
+
+    records = _collect_records(roots)
+    # leaves = tensors appearing as inputs with no grad_node
+    leaves: Dict[int, Tensor] = {}
+    for rec in records:
+        ins = (
+            rec.inputs_list
+            if isinstance(rec, tracer.PyFuncRecord)
+            else [t for ts in rec.inputs.values() for t in ts]
+        )
+        for t in ins:
+            if t.grad_node is None and not t.stop_gradient:
+                leaves[id(t)] = t
+    for t in roots:
+        if t.grad_node is None and not t.stop_gradient:
+            leaves[id(t)] = t
+
+    with jax.named_scope("backward"):
+        for rec in records:
+            _run_record_backward(rec, grad_map, create_graph=False, no_grad_ids=set())
+
+    for key, t in leaves.items():
+        g = grad_map.get(key)
+        if g is None:
+            continue
+        g_arr = g._array if isinstance(g, Tensor) else g
+        if t._grad is None:
+            t._grad = Tensor(g_arr, stop_gradient=True)
+        else:
+            t._grad = Tensor(t._grad._array + g_arr, stop_gradient=True)
+
+    if not retain_graph:
+        for rec in records:
+            _release(rec)
+        for t in roots:
+            t.grad_node = None
+
+
+def _release(rec):
+    if isinstance(rec, tracer.PyFuncRecord):
+        for t in rec.outputs_list:
+            t.grad_node = None
+        rec.inputs_list = []
+        rec.outputs_list = []
+    else:
+        for ts in rec.outputs.values():
+            for t in ts:
+                t.grad_node = None
+        rec.inputs = {}
+        rec.outputs = {}
+
+
+def calc_gradient(
+    outputs: Sequence,
+    inputs: Sequence,
+    grad_outputs: Optional[Sequence] = None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+    no_grad_vars: Optional[Sequence] = None,
+):
+    """``paddle.grad`` (partial_grad_engine.cc parity).  Returns grads wrt
+    ``inputs`` without touching ``.grad``; supports double grad via
+    ``create_graph``."""
+    from .tensor import Tensor
+
+    roots = list(outputs)
+    grad_map: Dict[int, object] = {}
+    _seed_roots(roots, grad_outputs, grad_map)
+    no_grad_ids = {id(t) for t in (no_grad_vars or ())}
+
+    records = _collect_records(roots)
+    if retain_graph is None:
+        retain_graph = create_graph
+    for rec in records:
+        _run_record_backward(rec, grad_map, create_graph=create_graph, no_grad_ids=no_grad_ids)
+
+    result = []
+    for t in inputs:
+        g = grad_map.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise ValueError(
+                    f"Tensor {t.name} is unreachable from outputs; pass "
+                    f"allow_unused=True to get None instead"
+                )
+            result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)
+        else:
+            result.append(Tensor(g, stop_gradient=not create_graph))
+    if not retain_graph:
+        for rec in records:
+            _release(rec)
+    return result

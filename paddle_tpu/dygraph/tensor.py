@@ -1,0 +1,232 @@
+"""Eager Tensor: a named jax.Array with tape-autograd metadata.
+
+Parity: ``VarBase`` (`/root/reference/paddle/fluid/imperative/layer.h:66`) and
+its Python monkey-patches (`fluid/dygraph/varbase_patch_methods.py`,
+`math_op_patch.py`).  Most ``paddle.*`` tensor functions are attached as
+methods by :mod:`paddle_tpu.tensor_api` (math_op_patch parity).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.dtype import convert_dtype, to_jax_dtype
+from . import tracer
+from .engine import run_backward
+
+
+class Tensor:
+    def __init__(
+        self,
+        data: Any,
+        dtype: Any = None,
+        stop_gradient: bool = True,
+        name: Optional[str] = None,
+        persistable: bool = False,
+    ):
+        if isinstance(data, Tensor):
+            data = data._array
+        if not isinstance(data, jax.Array):
+            arr = np.asarray(data)
+            if arr.dtype == np.float64 and dtype is None:
+                arr = arr.astype(np.float32)
+            data = jnp.asarray(arr)
+        if dtype is not None:
+            data = data.astype(to_jax_dtype(convert_dtype(dtype)))
+        self._array = data
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad_node = None  # producing tape record
+        self._grad: Optional["Tensor"] = None
+
+    # -- basic metadata --------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def dtype(self) -> str:
+        return str(self._array.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return self._array.ndim
+
+    def dim(self) -> int:
+        return self._array.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._array.size)
+
+    def numel(self) -> int:
+        return int(self._array.size)
+
+    @property
+    def place(self):
+        from ..framework.place import _get_current_place
+
+        return _get_current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.grad_node is None
+
+    # -- value access ----------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def item(self, *args):
+        return self._array.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._array).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._array)
+
+    def __int__(self):
+        return int(self._array)
+
+    def __bool__(self):
+        return bool(self._array)
+
+    def __len__(self):
+        if self._array.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._array.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+            f"stop_gradient={self.stop_gradient},\n       {np.asarray(self._array)})"
+        )
+
+    __str__ = __repr__
+
+    # -- autograd --------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value if (value is None or isinstance(value, Tensor)) else Tensor(value)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        run_backward([self], [grad_tensor] if grad_tensor is not None else None, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._array, stop_gradient=True, name=self.name + ".detached")
+        return t
+
+    def detach_(self) -> "Tensor":
+        self.grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return tracer.trace_op("assign", {"X": [self]}, {})["Out"][0]
+
+    # -- mutation (parity: VarBase set_value / optimizer in-place ops) ----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._array
+        self._array = jnp.asarray(value, self._array.dtype).reshape(self._array.shape)
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._array = jnp.full_like(self._array, value)
+        return self
+
+    def zero_(self):
+        self._array = jnp.zeros_like(self._array)
+        return self
+
+    def scale_(self, scale):
+        self._array = self._array * scale
+        return self
+
+    # -- dtype / shape helpers -------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        return tracer.trace_op(
+            "cast", {"X": [self]}, {"out_dtype": convert_dtype(dtype)}
+        )["Out"][0]
+
+    cast = astype
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            try:
+                return self.astype(convert_dtype(a))
+            except Exception:
+                continue
+        return self
+
+    @property
+    def T(self):
+        axes = list(range(self.ndim))[::-1]
+        return tracer.trace_op("transpose2", {"X": [self]}, {"axis": axes})["Out"][0]
+
+    # -- indexing --------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _normalize_index(idx)
+        return tracer.trace_fn(lambda a: a[idx], [self], name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _normalize_index(idx)
+        v = value._array if isinstance(value, Tensor) else jnp.asarray(value, self._array.dtype)
+        self._array = self._array.at[idx].set(v)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+
+def _normalize_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._array
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """Parity: ``paddle.to_tensor``."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._array, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

@@ -1,0 +1,221 @@
+"""Eager op tracer with tape autograd.
+
+Parity: ``Tracer::TraceOp`` (`/root/reference/paddle/fluid/imperative/tracer.cc:144`)
+— runs the kernel, wraps outputs in Tensors, and creates a grad node when any
+input requires grad (tracer.cc:231 CreateGradOpNode).  Backward execution
+lives in :mod:`engine` (BasicEngine parity).
+
+TPU-first: each (op, attrs) pair is compiled ONCE by XLA via ``jax.jit`` and
+re-dispatched by shape — the eager fast path the reference gets from its
+generated ``core.ops.*`` C functions, but with kernel fusion inside each op
+and no Python→C++ marshalling layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..framework import unique_name
+from ..ops import registry
+
+_state = threading.local()
+
+
+def _records() -> List:
+    if not hasattr(_state, "records"):
+        _state.records = []
+    return _state.records
+
+
+def has_grad() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(flag: bool) -> bool:
+    old = has_grad()
+    _state.grad_enabled = flag
+    return old
+
+
+# AMP state (parity: imperative/amp_auto_cast.* — tracer-level autocast)
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+def set_amp_state(st) -> None:
+    _state.amp = st
+
+
+class GradRecord:
+    """One taped forward op (parity: OpBase + GradOpNode, op_base.h:33,202)."""
+
+    __slots__ = ("seq", "type", "inputs", "outputs", "attrs", "rng")
+
+    _counter = [0]
+
+    def __init__(self, type: str, inputs, outputs, attrs, rng=None):
+        GradRecord._counter[0] += 1
+        self.seq = GradRecord._counter[0]
+        self.type = type
+        self.inputs = inputs  # slot -> list[Tensor]
+        self.outputs = outputs  # slot -> list[Tensor]
+        self.attrs = attrs
+        self.rng = rng
+
+    # Operator-duck-type for registry.make_grad_op_descs
+    def input(self, slot):
+        return [t.name for t in self.inputs.get(slot, [])]
+
+    def output(self, slot):
+        return [t.name for t in self.outputs.get(slot, [])]
+
+
+# ---------------------------------------------------------------------------
+# jit-cached eager kernel execution
+# ---------------------------------------------------------------------------
+
+# ops whose output shape depends on input VALUES — cannot jit eagerly
+_NONJIT = frozenset({"where_index", "unique", "masked_select", "bincount", "histogram"})
+
+_jit_cache: Dict[Any, Any] = {}
+
+
+def run_eager_kernel(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, Any], rng=None):
+    """Execute a registered kernel eagerly through a jit cache."""
+    op_def = registry.get_op_def(op_type)
+    if op_type in _NONJIT:
+        return registry.run_kernel(op_def, ins, attrs, rng=rng)
+    try:
+        key = (op_type, registry._freeze(attrs))
+        hash(key)
+    except TypeError:
+        return registry.run_kernel(op_def, ins, attrs, rng=rng)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        frozen_attrs = dict(attrs)
+
+        def _call(kins, rng_):
+            return registry.run_kernel(op_def, kins, frozen_attrs, rng=rng_)
+
+        fn = jax.jit(_call)
+        _jit_cache[key] = fn
+    return fn(ins, rng)
+
+
+# ---------------------------------------------------------------------------
+# trace_op: the dygraph dispatch entry
+# ---------------------------------------------------------------------------
+
+
+def _to_array(v):
+    from .tensor import Tensor
+
+    if isinstance(v, Tensor):
+        return v._array
+    if isinstance(v, (jax.Array, np.ndarray)):
+        return v
+    return np.asarray(v)
+
+
+def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any]):
+    """Run one op eagerly; returns slot -> list[Tensor]."""
+    from .tensor import Tensor
+
+    op_def = registry.get_op_def(op_type)
+
+    norm: Dict[str, List[Tensor]] = {}
+    for slot, vals in inputs.items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        ts = []
+        for v in vals:
+            if v is None:
+                continue
+            if not isinstance(v, Tensor):
+                v = Tensor(_to_array(v), stop_gradient=True)
+            ts.append(v)
+        if ts or slot in op_def.list_slots:
+            norm[slot] = ts
+
+    amp = amp_state()
+    if amp is not None:
+        from ..amp.auto_cast import maybe_autocast_inputs
+
+        norm, attrs = maybe_autocast_inputs(amp, op_type, norm, attrs)
+
+    ins_arrays = {slot: [t._array for t in ts] for slot, ts in norm.items()}
+
+    rng = None
+    if op_def.needs_rng:
+        from ..framework.random import next_rng_key
+
+        rng = next_rng_key()
+
+    outs = run_eager_kernel(op_type, ins_arrays, attrs, rng=rng)
+
+    requires_grad = (
+        has_grad()
+        and not op_def.no_grad
+        and any(
+            not t.stop_gradient
+            for slot, ts in norm.items()
+            if slot not in op_def.nondiff_slots
+            for t in ts
+        )
+    )
+
+    out_tensors: Dict[str, List[Tensor]] = {}
+    for slot, vals in outs.items():
+        stop = (not requires_grad) or (slot in op_def.nondiff_out_slots)
+        out_tensors[slot] = [Tensor(v, stop_gradient=stop) for v in vals]
+
+    if requires_grad:
+        rec = GradRecord(op_type, norm, out_tensors, dict(attrs), rng=rng)
+        for slot, ts in out_tensors.items():
+            if slot not in op_def.nondiff_out_slots:
+                for t in ts:
+                    t.grad_node = rec
+    return out_tensors
+
+
+def trace_fn(fn, tensors: List, name: str = "pyfunc"):
+    """Trace an arbitrary jax-traceable python function of tensor arrays.
+
+    Used for composite surface ops (indexing, custom PyLayer-like closures).
+    Gradients come from ``jax.vjp`` of ``fn`` replayed at backward time —
+    the dygraph analogue of the registry's auto-vjp grad ops.
+    """
+    from .tensor import Tensor
+
+    arrays = [t._array for t in tensors]
+    out_arrays = fn(*arrays)
+    single = not isinstance(out_arrays, (list, tuple))
+    if single:
+        out_arrays = [out_arrays]
+    requires_grad = has_grad() and any(not t.stop_gradient for t in tensors)
+    outs = [Tensor(a, stop_gradient=not requires_grad) for a in out_arrays]
+    if requires_grad:
+        rec = PyFuncRecord(fn, tensors, outs, single)
+        for t in outs:
+            t.grad_node = rec
+    return outs[0] if single else outs
+
+
+class PyFuncRecord:
+    """Tape node for trace_fn closures (PyLayer-style custom autograd)."""
+
+    __slots__ = ("seq", "fn", "inputs_list", "outputs_list", "single")
+
+    def __init__(self, fn, inputs_list, outputs_list, single):
+        GradRecord._counter[0] += 1
+        self.seq = GradRecord._counter[0]
+        self.fn = fn
+        self.inputs_list = inputs_list
+        self.outputs_list = outputs_list
+        self.single = single

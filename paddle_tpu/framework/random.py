@@ -1,0 +1,46 @@
+"""Global RNG for dygraph mode — explicit JAX PRNG key chain.
+
+Parity role: the reference's global Generator + ``paddle.seed``
+(`/root/reference/python/paddle/fluid/framework.py` seed plumbing, CUDA
+generator state).  TPU-first: a split-chain of PRNG keys (stateless under
+jit; the static Executor threads its own fold_in(seed, step) keys instead).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def seed(s: int):
+    """Parity: ``paddle.seed`` — reseeds the dygraph RNG chain and the
+    default static programs' random_seed."""
+    global _DEFAULT_SEED
+    _DEFAULT_SEED = int(s)
+    _state.key = jax.random.PRNGKey(int(s))
+    from . import program as fw
+
+    fw.default_main_program().random_seed = int(s)
+    fw.default_startup_program().random_seed = int(s)
+    return _state.key
+
+
+def next_rng_key():
+    key = getattr(_state, "key", None)
+    if key is None:
+        key = jax.random.PRNGKey(_DEFAULT_SEED)
+    key, sub = jax.random.split(key)
+    _state.key = key
+    return sub
+
+
+def get_rng_state():
+    return getattr(_state, "key", jax.random.PRNGKey(_DEFAULT_SEED))
+
+
+def set_rng_state(key):
+    _state.key = key

@@ -342,10 +342,13 @@ def make_grad_op_descs(op, no_grad_set: Optional[Set[str]] = None) -> List[dict]
     if fwd.grad_maker is not None:
         return fwd.grad_maker(op, no_grad_set)
     get_grad_op_def(op.type)  # ensure registered
-    inputs = {s: list(v) for s, v in op.inputs.items()}
+    # NOTE: use the .input()/.output() accessors (name lists) — op may be a
+    # static Operator (slot->names) or a dygraph GradRecord (slot->Tensors).
+    inputs = {s: list(op.input(s)) for s in op.inputs}
     if fwd.grad_inputs is not None:
         inputs = {s: v for s, v in inputs.items() if s in fwd.grad_inputs}
-    for slot, names in op.outputs.items():
+    for slot in op.outputs:
+        names = op.output(slot)
         if slot in fwd.nondiff_out_slots:
             # bookkeeping outputs (masks, saved stats) feed the grad op as
             # values, not as gradients
@@ -353,9 +356,10 @@ def make_grad_op_descs(op, no_grad_set: Optional[Set[str]] = None) -> List[dict]
             continue
         inputs[slot + GRAD_SUFFIX] = [n + GRAD_SUFFIX for n in names]
     outputs = {}
-    for slot, names in op.inputs.items():
+    for slot in op.inputs:
         if slot in fwd.nondiff_slots:
             continue
+        names = op.input(slot)
         outs = [
             (n + GRAD_SUFFIX) if n not in no_grad_set else ""
             for n in names

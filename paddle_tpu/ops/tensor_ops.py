@@ -393,9 +393,15 @@ def arg_max_kernel(ins, attrs):
 
 @register_op("arg_min", nondiff_slots=("X",), no_grad=True)
 def arg_min_kernel(ins, attrs):
-    axis = attrs.get("axis", -1)
+    x = ins["X"]
     dtype = to_jax_dtype(attrs.get("dtype", "int64"))
-    return {"Out": jnp.argmin(ins["X"], axis=axis).astype(dtype)}
+    if attrs.get("flatten", False):
+        out = jnp.argmin(jnp.reshape(x, (-1,)))
+    else:
+        out = jnp.argmin(x, axis=attrs.get("axis", -1))
+        if attrs.get("keepdims", False):
+            out = jnp.expand_dims(out, attrs.get("axis", -1))
+    return {"Out": out.astype(dtype)}
 
 
 @register_op("argsort", nondiff_slots=("X",), no_grad=True)
@@ -457,3 +463,61 @@ def meshgrid_kernel(ins, attrs):
 @register_op("broadcast_to")
 def broadcast_to_kernel(ins, attrs):
     return {"Out": jnp.broadcast_to(ins["X"], attrs["shape"])}
+
+
+@register_op("diag_v2")
+def diag_v2_kernel(ins, attrs):
+    x = ins["X"]
+    offset = attrs.get("offset", 0)
+    if x.ndim == 1:
+        pad = attrs.get("padding_value", 0.0)
+        out = jnp.diag(x, k=offset)
+        if pad != 0.0:
+            mask = jnp.diag(jnp.ones_like(x), k=offset) > 0
+            out = jnp.where(mask, out, jnp.asarray(pad, x.dtype))
+        return {"Out": out}
+    return {"Out": jnp.diagonal(x, offset=offset)}
+
+
+@register_op("kron")
+def kron_kernel(ins, attrs):
+    return {"Out": jnp.kron(ins["X"], ins["Y"])}
+
+
+@register_op("cross")
+def cross_kernel(ins, attrs):
+    axis = attrs.get("dim", -1)
+    return {"Out": jnp.cross(ins["X"], ins["Y"], axis=axis)}
+
+
+@register_op("multiplex", list_slots=("X",), nondiff_slots=("Ids",))
+def multiplex_kernel(ins, attrs):
+    """Parity: multiplex_op — row i of Out comes from input Ids[i]."""
+    xs = jnp.stack(ins["X"], axis=0)
+    ids = ins["Ids"].reshape(-1)
+    return {"Out": jnp.take_along_axis(
+        xs, ids.reshape((1, -1) + (1,) * (xs.ndim - 2)), axis=0
+    )[0]}
+
+
+@register_op("histogram", nondiff_slots=("X",), no_grad=True)
+def histogram_kernel(ins, attrs):
+    x = ins["X"]
+    bins = attrs.get("bins", 100)
+    lo, hi = attrs.get("min", 0), attrs.get("max", 0)
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return {"Out": hist.astype(jnp.int64)}
+
+
+@register_op("bincount", nondiff_slots=("X",), no_grad=True)
+def bincount_kernel(ins, attrs):
+    x = ins["X"]
+    w = ins.get("Weights")
+    minlength = attrs.get("minlength", 0)
+    # jnp.bincount needs a static length under jit; eager numpy fallback
+    import numpy as np
+
+    out = np.bincount(np.asarray(x), weights=None if w is None else np.asarray(w), minlength=minlength)
+    return {"Out": jnp.asarray(out)}

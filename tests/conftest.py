@@ -14,7 +14,8 @@ if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# (x64 stays ON — paddle_tpu enables it for int64 API parity; float dtypes
+# are managed explicitly by the framework.)
 
 # The image's sitecustomize imports jax at interpreter start with
 # JAX_PLATFORMS=axon (the TPU tunnel), so jax's config snapshot ignores the

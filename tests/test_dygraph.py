@@ -1,0 +1,193 @@
+"""Dygraph engine tests (parity role: reference test_imperative_basic.py,
+test_imperative_autograd — VarBase/Tracer/BasicEngine behavior)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_basic_arithmetic():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((x / y).numpy(), [0.25, 0.4, 0.5])
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_backward_chain_matmul():
+    a = paddle.to_tensor(np.ones((2, 3), "float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.full((3, 4), 2.0, "float32"), stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.full((2, 3), 8.0))
+    np.testing.assert_allclose(b.grad.numpy(), np.full((3, 4), 2.0))
+
+
+def test_grad_accumulation_two_backwards():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y1 = x * x
+    y1.backward()
+    y2 = x * x * x
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0 + 12.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_multi_use_accumulates():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x + x * 2.0  # x used twice
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    out = (x * y).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach()
+    assert z.stop_gradient
+    out = (y * 3).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+    assert y.grad_node is None
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (dx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(dx.numpy(), [4.0])
+    assert x.grad is None  # paddle.grad must not write .grad
+
+
+def test_double_grad():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x
+    (dy_dx,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(dy_dx.numpy(), [27.0])
+    (d2y_dx2,) = paddle.grad(dy_dx, x)
+    np.testing.assert_allclose(d2y_dx2.numpy(), [18.0])
+
+
+def test_indexing_forward_and_grad():
+    x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4), stop_gradient=False)
+    y = x[1]
+    np.testing.assert_allclose(y.numpy(), [4, 5, 6, 7])
+    y.sum().backward()
+    expect = np.zeros((3, 4), "float32")
+    expect[1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_setitem():
+    x = paddle.to_tensor(np.zeros((2, 2), "float32"))
+    x[0, 1] = 5.0
+    np.testing.assert_allclose(x.numpy(), [[0, 5], [0, 0]])
+
+
+def test_astype_and_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int64")
+    assert y.dtype == "int64"
+    np.testing.assert_allclose(y.numpy(), [1, 2])
+
+
+def test_comparison_ops():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+    np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+
+
+def test_reshape_transpose_concat():
+    x = paddle.to_tensor(np.arange(6, dtype="float32"))
+    y = paddle.reshape(x, [2, 3])
+    z = paddle.transpose(y, [1, 0])
+    assert z.shape == [3, 2]
+    c = paddle.concat([y, y], axis=0)
+    assert c.shape == [4, 3]
+
+
+def test_reduction_keepdim():
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    s = paddle.sum(x, axis=1, keepdim=True)
+    assert s.shape == [2, 1]
+    m = paddle.mean(x)
+    np.testing.assert_allclose(m.numpy(), 1.0)
+
+
+def test_dropout_backward_uses_mask():
+    paddle.seed(42)
+    from paddle_tpu.ops.dispatch import dispatch
+
+    x = paddle.to_tensor(np.ones((100,), "float32"), stop_gradient=False)
+    outs = dispatch("dropout", {"X": [x]}, {"dropout_prob": 0.5})
+    out, mask = outs["Out"][0], outs["Mask"][0]
+    out.sum().backward()
+    # grad must be 2.0 where kept, 0 where dropped (upscale_in_train)
+    kept = mask.numpy().astype(bool)
+    g = x.grad.numpy()
+    assert np.allclose(g[kept], 2.0)
+    assert np.allclose(g[~kept], 0.0)
+
+
+def test_rng_ops_vary_and_seed_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4])
+    b = paddle.randn([4])
+    assert not np.allclose(a.numpy(), b.numpy())
+    paddle.seed(7)
+    a2 = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), a2.numpy())
+
+
+def test_retain_graph_false_releases():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    y.backward()  # graph released: must not flow to x again
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_retain_graph_true_allows_second_backward():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
