@@ -212,6 +212,25 @@ class Tensor:
     def __hash__(self):
         return id(self)
 
+    def __deepcopy__(self, memo):
+        """Copy with a FRESH unique name (parity: ParamBase.__deepcopy__) —
+        name collisions would corrupt optimizer accumulators keyed by name."""
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        new._array = self._array  # jax arrays are immutable
+        new.name = unique_name.generate(self.name.split("_")[0] or "eager_tmp")
+        new.stop_gradient = self.stop_gradient
+        new.persistable = self.persistable
+        new.grad_node = None
+        new._grad = None
+        for k, v in self.__dict__.items():
+            if k not in new.__dict__:
+                import copy as _copy
+
+                new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
 
 def _normalize_index(idx):
     def conv(i):

@@ -126,6 +126,31 @@ class Variable:
 class Parameter(Variable):
     """Parity: ``framework.py`` Parameter — persistable trainable Variable."""
 
+    def __deepcopy__(self, memo):
+        """Create a NEW parameter (fresh name) in the same block, replaying
+        the initializer into the startup program — used when layers are
+        deep-copied (e.g. TransformerEncoder stacking)."""
+        new = self.block.create_parameter(
+            shape=self.shape,
+            dtype=self.dtype,
+            name=unique_name.generate(self.name.rsplit("_", 1)[0]),
+            trainable=self.trainable,
+            initializer=self.initializer,
+            regularizer=self.regularizer,
+            need_clip=self.need_clip,
+        )
+        memo[id(self)] = new
+        if self.initializer is not None:
+            from ..nn.initializer import Initializer
+
+            if isinstance(self.initializer, Initializer):
+                from . import program as _fw
+
+                self.initializer.apply_static(
+                    new, _fw.default_startup_program().global_block()
+                )
+        return new
+
     def __init__(self, block, shape, dtype, name=None, trainable=True, **kwargs):
         initializer = kwargs.pop("initializer", None)
         regularizer = kwargs.pop("regularizer", None)

@@ -1,0 +1,10 @@
+"""Pallas / fused kernel tier (SURVEY.md §7 layer 8).
+
+Role parity: ``/root/reference/paddle/fluid/operators/fused/`` (53 hand-CUDA
+files — multihead_matmul attention, fused layernorm variants, …).  Here the
+fused ops are (a) jnp compositions XLA already fuses, and (b) Pallas TPU
+kernels for the cases XLA doesn't fuse well (flash attention tiling), with
+interpreter fallback on CPU.
+"""
+
+from . import attention  # noqa: F401

@@ -1,0 +1,87 @@
+"""Fused scaled-dot-product attention.
+
+Role parity: the reference's attention fusion ``multihead_matmul_op.cu``
+(`/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu`) —
+inference-only there; here a full fwd/bwd fused attention usable from
+``paddle.nn.functional.scaled_dot_product_attention`` and MultiHeadAttention.
+
+Two tiers:
+  * ``_sdpa_reference``: straight jnp — XLA fuses the softmax chain; this is
+    the CPU/interpret path and the autodiff path.
+  * Pallas flash-attention kernel (paddle_tpu.kernels.flash) used on TPU for
+    long sequences — registered lazily to keep CPU tests hermetic.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import register_op
+
+
+def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False):
+    """q,k,v: [..., seq, head_dim] (any leading batch/head dims)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(s, q.dtype)
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        logits = jnp.where(causal, logits, jnp.asarray(-1e9, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e9, logits.dtype))
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+
+def sdpa(q, k, v, mask=None, scale=None, is_causal=False):
+    """Dispatch to the Pallas flash kernel on TPU when profitable, else the
+    XLA-fused reference."""
+    try:
+        from . import flash
+
+        if flash.available() and mask is None and q.shape[-2] >= 512:
+            return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
+    except ImportError:
+        pass
+    return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal)
+
+
+@register_op("scaled_dot_product_attention", needs_rng=True)
+def sdpa_kernel(ins, attrs, rng=None):
+    q, k, v = ins["Q"], ins["K"], ins["V"]
+    mask = ins.get("Mask")
+    out = sdpa(
+        q, k, v, mask=mask,
+        scale=attrs.get("scale"),
+        is_causal=attrs.get("is_causal", False),
+    )
+    p = attrs.get("dropout_p", 0.0)
+    if p > 0.0 and not attrs.get("is_test", False):
+        keep = jax.random.bernoulli(rng, 1.0 - p, out.shape)
+        out = jnp.where(keep, out / (1.0 - p), jnp.zeros_like(out))
+    return {"Out": out}
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True):
+    from ..ops.dispatch import dispatch, single
+
+    ins = {"Q": [query], "K": [key], "V": [value]}
+    if attn_mask is not None:
+        ins["Mask"] = [attn_mask]
+    return single(
+        dispatch(
+            "scaled_dot_product_attention",
+            ins,
+            {"dropout_p": dropout_p, "is_causal": is_causal, "is_test": not training},
+        )
+    )

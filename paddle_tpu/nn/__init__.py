@@ -1,0 +1,17 @@
+"""``paddle.nn`` — layers, functional, initializers.
+
+Parity: ``/root/reference/python/paddle/nn/__init__.py`` surface.
+"""
+
+from .layer_base import (  # noqa: F401
+    EagerParameter,
+    Layer,
+    LayerList,
+    ParamAttr,
+    ParameterList,
+    Sequential,
+)
+from .layer import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401

@@ -1,0 +1,225 @@
+"""Parameter initializers.
+
+Parity: ``/root/reference/python/paddle/fluid/initializer.py`` (Constant,
+Uniform, Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Assign) and the 2.x
+re-exports ``python/paddle/nn/initializer/``.
+
+Mode-polymorphic like the reference: in dygraph an initializer computes the
+value eagerly; in static mode it appends the init op to the STARTUP program
+targeting the parameter (the executor then materializes it on first run).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...framework import program as fw
+from ...framework.dtype import convert_dtype
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    return gains.get(nonlinearity, 1.0)
+
+
+def _fan_in_out(shape: Sequence[int]):
+    shape = list(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = shape[0] if shape else 1
+    else:
+        receptive = 1
+        for s in shape[2:]:
+            receptive *= s
+        # paddle convention: fan_in = shape[0]*receptive? For FC (in,out):
+        fan_in = shape[0] * receptive
+        fan_out = shape[1] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    """Base. Subclasses define ``_op`` returning (op_type, attrs) or override
+    the whole __call__."""
+
+    def _op(self, shape, dtype):
+        raise NotImplementedError
+
+    # -- static mode: append to startup program --------------------------
+    def apply_static(self, param, startup_block) -> None:
+        op_type, attrs = self._op(tuple(param.shape), param.dtype)
+        if not startup_block.has_var(param.name):
+            startup_block.create_parameter(
+                name=param.name, shape=param.shape, dtype=param.dtype
+            )
+        startup_block.append_op(
+            type=op_type, inputs={}, outputs={"Out": [param.name]}, attrs=attrs
+        )
+
+    # -- dygraph mode: compute eagerly ------------------------------------
+    def apply_dygraph(self, shape, dtype):
+        from ...dygraph import tracer
+
+        op_type, attrs = self._op(tuple(shape), convert_dtype(dtype))
+        outs = tracer.run_eager_kernel(
+            op_type,
+            {},
+            attrs,
+            rng=_init_rng(),
+        )
+        return outs["Out"][0]
+
+    def __call__(self, param, block=None):
+        if isinstance(param, fw.Variable):
+            block = block if block is not None else fw.default_startup_program().global_block()
+            return self.apply_static(param, block)
+        return self.apply_dygraph(param.shape, param.dtype)
+
+
+def _init_rng():
+    from ...framework.random import next_rng_key
+
+    return next_rng_key()
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = float(value)
+
+    def _op(self, shape, dtype):
+        return "fill_constant", {"shape": list(shape), "value": self.value, "dtype": dtype}
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, seed: int = 0):
+        self.mean, self.std = mean, std
+
+    def _op(self, shape, dtype):
+        return "gaussian_random", {
+            "shape": list(shape), "mean": self.mean, "std": self.std, "dtype": dtype,
+        }
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def _op(self, shape, dtype):
+        return "truncated_gaussian_random", {
+            "shape": list(shape), "mean": self.mean, "std": self.std, "dtype": dtype,
+        }
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def _op(self, shape, dtype):
+        return "uniform_random", {
+            "shape": list(shape), "min": self.low, "max": self.high, "dtype": dtype,
+        }
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _op(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return "uniform_random", {
+            "shape": list(shape), "min": -limit, "max": limit, "dtype": dtype,
+        }
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _op(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return "gaussian_random", {
+            "shape": list(shape), "mean": 0.0, "std": std, "dtype": dtype,
+        }
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def _op(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self.gain * math.sqrt(3.0 / fi)
+        return "uniform_random", {
+            "shape": list(shape), "min": -limit, "max": limit, "dtype": dtype,
+        }
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def _op(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self.gain / math.sqrt(fi)
+        return "gaussian_random", {
+            "shape": list(shape), "mean": 0.0, "std": std, "dtype": dtype,
+        }
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def apply_static(self, param, startup_block) -> None:
+        if not startup_block.has_var(param.name):
+            startup_block.create_parameter(
+                name=param.name, shape=param.shape, dtype=param.dtype
+            )
+        startup_block.append_op(
+            type="assign_value",
+            inputs={},
+            outputs={"Out": [param.name]},
+            attrs={
+                "shape": list(self.value.shape),
+                "dtype": param.dtype,
+                "values": self.value.reshape(-1).tolist(),
+            },
+        )
+
+    def apply_dygraph(self, shape, dtype):
+        import jax.numpy as jnp
+
+        from ...framework.dtype import to_jax_dtype
+
+        return jnp.asarray(self.value, to_jax_dtype(convert_dtype(dtype)))
+
+
+# aliases matching reference naming (initializer.py MSRAInitializer etc.)
+MSRA = KaimingNormal

@@ -363,7 +363,18 @@ def dropout_kernel(ins, attrs, rng=None):
         if impl == "upscale_in_train":
             return {"Out": x, "Mask": jnp.ones(x.shape, dtype=jnp.uint8)}
         return {"Out": x * (1.0 - p), "Mask": jnp.ones(x.shape, dtype=jnp.uint8)}
-    keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+    # axis-restricted mask (spatial dropout: dropout2d passes axis=[0,1])
+    axes = attrs.get("axis")
+    if axes is not None:
+        if isinstance(axes, int):
+            axes = [axes]
+        mask_shape = tuple(
+            x.shape[i] if i in axes else 1 for i in range(x.ndim)
+        )
+    else:
+        mask_shape = x.shape
+    keep = jax.random.bernoulli(rng, 1.0 - p, mask_shape)
+    keep = jnp.broadcast_to(keep, x.shape)
     if impl == "upscale_in_train":
         scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
         out = jnp.where(keep, x * jnp.asarray(scale, x.dtype), jnp.zeros_like(x))
@@ -429,15 +440,14 @@ def softmax_with_cross_entropy_kernel(ins, attrs):
         loss = -jnp.sum(label * log_softmax, axis=axis, keepdims=True)
     else:
         lab = label
-        squeeze_back = False
         if lab.ndim == logits.ndim:
             lab = jnp.squeeze(lab, axis)
-            squeeze_back = True
-        picked = jnp.take_along_axis(log_softmax, jnp.expand_dims(lab, axis), axis=axis)
-        loss = -picked
-        if ignore_index >= 0:
-            valid = jnp.expand_dims(lab, axis) != ignore_index
-            loss = jnp.where(valid, loss, jnp.zeros_like(loss))
+        # mask ignore_index whatever its sign (paddle default is -100) and
+        # gather through a safe index to avoid negative-index wraparound
+        valid = lab != ignore_index
+        safe_lab = jnp.where(valid, lab, jnp.zeros_like(lab))
+        picked = jnp.take_along_axis(log_softmax, jnp.expand_dims(safe_lab, axis), axis=axis)
+        loss = jnp.where(jnp.expand_dims(valid, axis), -picked, jnp.zeros_like(picked))
     return {"Softmax": softmax, "Loss": loss.astype(logits.dtype)}
 
 
@@ -454,11 +464,11 @@ def softmax_with_cross_entropy_grad_kernel(ins, attrs):
         lab = label
         if lab.ndim == softmax.ndim:
             lab = jnp.squeeze(lab, axis)
-        onehot = jax.nn.one_hot(lab, softmax.shape[axis], axis=axis, dtype=softmax.dtype)
+        valid = lab != ignore_index
+        safe_lab = jnp.where(valid, lab, jnp.zeros_like(lab))
+        onehot = jax.nn.one_hot(safe_lab, softmax.shape[axis], axis=axis, dtype=softmax.dtype)
         dlogits = (softmax - onehot) * dloss
-        if ignore_index >= 0:
-            valid = jnp.expand_dims(lab != ignore_index, axis)
-            dlogits = jnp.where(valid, dlogits, jnp.zeros_like(dlogits))
+        dlogits = jnp.where(jnp.expand_dims(valid, axis), dlogits, jnp.zeros_like(dlogits))
     return {"Logits" + GRAD_SUFFIX: dlogits}
 
 

@@ -122,6 +122,14 @@ def assign_kernel(ins, attrs):
     return {"Out": ins["X"]}
 
 
+@register_op("assign_value", no_grad=True)
+def assign_value_kernel(ins, attrs):
+    """Parity: assign_value_op — materialize a literal (used by Assign init)."""
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    vals = attrs.get("values", attrs.get("fp32_values", []))
+    return {"Out": jnp.asarray(vals, dtype=dtype).reshape(attrs["shape"])}
+
+
 @register_op("shape", nondiff_slots=("Input",), no_grad=True)
 def shape_kernel(ins, attrs):
     return {"Out": jnp.asarray(ins["Input"].shape, dtype=jnp.int32)}

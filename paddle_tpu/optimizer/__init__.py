@@ -1,0 +1,389 @@
+"""``paddle.optimizer`` — Optimizer base + SGD/Momentum/Adam/AdamW/Lamb/
+RMSProp/Adagrad + lr schedulers.
+
+Parity: ``/root/reference/python/paddle/optimizer/optimizer.py`` (base:
+accumulators, regularization, grad clip, minimize/step/clear_grad) and the
+per-optimizer modules (adam.py, adamw.py, momentum.py, lamb.py, sgd.py,
+rmsprop.py, adagrad.py); schedulers in lr.py.
+
+Both modes share ONE update-kernel path: in static mode the update op is
+appended with outputs bound to the SAME persistable vars (executor donates →
+in-place in HBM); in dygraph the kernel runs eagerly and the param/state
+arrays are rebound.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework import program as fw
+from ..framework import unique_name
+from ..framework.scope import global_scope
+from ..dygraph.tensor import Tensor
+from ..dygraph import tracer
+from . import lr as lr_sched_mod
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "RMSProp",
+    "Adagrad", "lr",
+]
+
+lr = lr_sched_mod
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        from ..regularizer import L2Decay
+
+        if isinstance(weight_decay, float):
+            self.regularization = L2Decay(weight_decay)
+        else:
+            self.regularization = weight_decay
+        self._grad_clip = grad_clip
+        # accumulators: acc_name -> param_name -> Tensor (dygraph) / Variable (static)
+        self._accumulators: Dict[str, Dict[str, object]] = {}
+        self._lr_var = None  # static-mode persistable lr var
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+        self._sync_static_lr()
+
+    def _sync_static_lr(self):
+        if self._lr_var is not None:
+            import jax.numpy as jnp
+
+            global_scope().set(
+                self._lr_var.name, jnp.asarray([self.get_lr()], jnp.float32)
+            )
+
+    def _lr_input(self):
+        """LearningRate input for update kernels in the current mode."""
+        if fw.in_dygraph_mode():
+            return Tensor(np.asarray([self.get_lr()], "float32"))
+        if self._lr_var is None:
+            block = fw.default_main_program().global_block()
+            self._lr_var = block.create_var(
+                name=unique_name.generate("learning_rate"),
+                shape=(1,), dtype="float32", persistable=True, stop_gradient=True,
+            )
+            sb = fw.default_startup_program().global_block()
+            sb.create_var(name=self._lr_var.name, shape=(1,), dtype="float32", persistable=True)
+            sb.append_op(
+                type="fill_constant", inputs={}, outputs={"Out": [self._lr_var.name]},
+                attrs={"shape": [1], "value": self.get_lr(), "dtype": "float32"},
+            )
+        return self._lr_var
+
+    # -- accumulators -----------------------------------------------------
+    def _add_accumulator(self, name: str, param, fill_value: float = 0.0,
+                         shape=None, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        pname = param.name
+        if pname in store:
+            return store[pname]
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or "float32"
+        if fw.in_dygraph_mode():
+            import jax.numpy as jnp
+
+            from ..framework.dtype import to_jax_dtype
+
+            acc = Tensor(jnp.full(shape, fill_value, to_jax_dtype(dtype)), stop_gradient=True)
+        else:
+            block = fw.default_main_program().global_block()
+            acc = block.create_var(
+                name=unique_name.generate(f"{pname}_{name}"),
+                shape=shape, dtype=dtype, persistable=True, stop_gradient=True,
+            )
+            sb = fw.default_startup_program().global_block()
+            sb.create_var(name=acc.name, shape=shape, dtype=dtype, persistable=True)
+            sb.append_op(
+                type="fill_constant", inputs={}, outputs={"Out": [acc.name]},
+                attrs={"shape": shape, "value": fill_value, "dtype": dtype},
+            )
+        store[pname] = acc
+        return acc
+
+    # -- the shared update executor ---------------------------------------
+    def _run_update(self, op_type: str, ins: Dict[str, list], bind: Dict[str, object],
+                    attrs: Dict[str, object]):
+        """Run/append an update op.  ``bind`` maps output slot -> the var or
+        Tensor that must receive the new value (in-place semantics)."""
+        if fw.in_dygraph_mode():
+            arrays = {s: [t._array if isinstance(t, Tensor) else t for t in vs]
+                      for s, vs in ins.items()}
+            outs = tracer.run_eager_kernel(op_type, arrays, attrs)
+            for slot, target in bind.items():
+                if slot in outs and target is not None:
+                    target._array = outs[slot][0]
+            return
+        from ..ops.dispatch import dispatch_static
+
+        dispatch_static(
+            op_type, ins, attrs,
+            outputs={slot: [v] for slot, v in bind.items() if v is not None},
+        )
+
+    # -- main entries ------------------------------------------------------
+    def _params_grads_dygraph(self) -> List[Tuple]:
+        assert self._parameter_list is not None, (
+            "pass `parameters=` to the optimizer for dygraph mode"
+        )
+        out = []
+        for p in self._parameter_list:
+            if getattr(p, "trainable", True) and p.grad is not None:
+                out.append((p, p.grad))
+        return out
+
+    def _apply_regularization(self, params_grads):
+        if self.regularization is None:
+            return params_grads
+        out = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None:
+                g = reg(p, g)
+            out.append((p, g))
+        return out
+
+    def _apply_clip(self, params_grads):
+        if self._grad_clip is not None:
+            return self._grad_clip(params_grads)
+        return params_grads
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    def step(self):
+        """Dygraph update (parity: Optimizer.step / minimize dygraph branch)."""
+        params_grads = self._params_grads_dygraph()
+        params_grads = self._apply_regularization(params_grads)
+        params_grads = self._apply_clip(params_grads)
+        for p, g in params_grads:
+            self._append_optimize_op(p, g)
+
+    def clear_grad(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        if fw.in_dygraph_mode():
+            self.step()
+            return None, self._params_grads_dygraph()
+        from ..static.backward import append_backward
+
+        params_grads = append_backward(loss, parameters, no_grad_set)
+        params_grads = self._apply_regularization(params_grads)
+        params_grads = self._apply_clip(params_grads)
+        for p, g in params_grads:
+            self._append_optimize_op(p, g)
+        return None, params_grads
+
+    def apply_gradients(self, params_grads):
+        params_grads = self._apply_regularization(params_grads)
+        params_grads = self._apply_clip(params_grads)
+        for p, g in params_grads:
+            self._append_optimize_op(p, g)
+
+    def _append_optimize_op(self, param, grad):
+        raise NotImplementedError
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        d = {}
+        for acc_name, store in self._accumulators.items():
+            for pname, acc in store.items():
+                d[f"{pname}/{acc_name}"] = acc
+        if isinstance(self._learning_rate, LRScheduler):
+            d["LR_Scheduler"] = self._learning_rate.state_dict()
+        return d
+
+    def set_state_dict(self, state):
+        for key, val in state.items():
+            if key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(val)
+                continue
+            pname, acc_name = key.rsplit("/", 1)
+            tgt = self._accumulators.get(acc_name, {}).get(pname)
+            if tgt is not None and isinstance(tgt, Tensor):
+                tgt.set_value(val.numpy() if hasattr(val, "numpy") else val)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _append_optimize_op(self, p, g):
+        self._run_update(
+            "sgd",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()]},
+            {"ParamOut": p},
+            {},
+        )
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, p, g):
+        vel = self._add_accumulator("velocity", p)
+        self._run_update(
+            "momentum",
+            {"Param": [p], "Grad": [g], "Velocity": [vel],
+             "LearningRate": [self._lr_input()]},
+            {"ParamOut": p, "VelocityOut": vel},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    _op = "adam"
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, p, g):
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+        self._run_update(
+            self._op,
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()],
+             "Moment1": [m1], "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+             "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon,
+             **self._extra_attrs()},
+        )
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    _op = "adamw"
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff, "with_decay": True}
+
+    def _append_optimize_op(self, p, g):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            # fall back to plain adam for excluded params
+            saved, self._op = self._op, "adam"
+            try:
+                Adam._append_optimize_op(self, p, g)
+            finally:
+                self._op = saved
+            return
+        Adam._append_optimize_op(self, p, g)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, p, g):
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        self._run_update(
+            "lamb",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()],
+             "Moment1": [m1], "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+             "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon,
+             "weight_decay": wd},
+        )
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, p, g):
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum", p)
+        ins = {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()],
+               "MeanSquare": [ms], "Moment": [mom]}
+        bind = {"ParamOut": p, "MeanSquareOut": ms, "MomentOut": mom}
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            ins["MeanGrad"] = [mg]
+            bind["MeanGradOut"] = mg
+        self._run_update(
+            "rmsprop", ins, bind,
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _append_optimize_op(self, p, g):
+        mom = self._add_accumulator("moment", p, fill_value=self._init_acc)
+        self._run_update(
+            "adagrad",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()],
+             "Moment": [mom]},
+            {"ParamOut": p, "MomentOut": mom},
+            {"epsilon": self._epsilon},
+        )

@@ -1,0 +1,305 @@
+"""nn.Layer / layers / functional tests (parity role: reference
+test_layers.py, test_imperative_mnist.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def test_linear_forward_shapes():
+    l = nn.Linear(4, 7)
+    y = l(paddle.randn([3, 4]))
+    assert y.shape == [3, 7]
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(2, 3)
+            self.fc2 = nn.Linear(3, 1)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    assert set(sd) == set(names)
+    # roundtrip
+    net2 = Net()
+    missing, unexpected = net2.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+
+def test_train_eval_mode_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+    d.train()
+    y = d(x)
+    assert (y.numpy() == 0).any()
+
+
+def test_mlp_training_loss_decreases(rng):
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
+    optim = opt.Adam(learning_rate=0.01, parameters=net.parameters())
+    w = rng.randn(8, 1).astype("float32")
+    losses = []
+    for _ in range(40):
+        x = paddle.to_tensor(rng.randn(64, 8).astype("float32"))
+        y = paddle.matmul(x, paddle.to_tensor(w))
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_conv_bn_pool_stack():
+    m = nn.Sequential(
+        nn.Conv2D(1, 4, 3, padding=1), nn.BatchNorm2D(4), nn.ReLU(), nn.MaxPool2D(2),
+    )
+    y = m(paddle.randn([2, 1, 8, 8]))
+    assert y.shape == [2, 4, 4, 4]
+    # BN stats updated in train mode
+    before = m[1]._mean.numpy().copy()
+    m(paddle.randn([2, 1, 8, 8]))
+    assert not np.allclose(before, m[1]._mean.numpy())
+    # eval mode: stats frozen
+    m.eval()
+    frozen = m[1]._mean.numpy().copy()
+    m(paddle.randn([2, 1, 8, 8]))
+    np.testing.assert_allclose(frozen, m[1]._mean.numpy())
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(paddle.to_tensor(np.array([0, 1], "int64")))
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+    assert not np.allclose(out.numpy()[1], 0)
+
+
+def test_multihead_attention_shapes_and_grad():
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 5, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+    out.mean().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_causal_mask():
+    paddle.seed(0)
+    t = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1, num_decoder_layers=1,
+                       dim_feedforward=32, dropout=0.0)
+    src = paddle.randn([1, 4, 16])
+    tgt = paddle.randn([1, 4, 16])
+    mask = t.generate_square_subsequent_mask(4)
+    out = t(src, tgt, tgt_mask=mask)
+    assert out.shape == [1, 4, 16]
+
+
+def test_optimizer_momentum_sgd_adamw(rng):
+    for make in (
+        lambda ps: opt.SGD(0.1, parameters=ps),
+        lambda ps: opt.Momentum(0.1, parameters=ps),
+        lambda ps: opt.AdamW(0.01, parameters=ps),
+        lambda ps: opt.RMSProp(0.01, parameters=ps),
+        lambda ps: opt.Adagrad(0.1, parameters=ps),
+        lambda ps: opt.Lamb(0.01, parameters=ps),
+    ):
+        l = nn.Linear(3, 1)
+        o = make(l.parameters())
+        before = l.weight.numpy().copy()
+        loss = l(paddle.ones([2, 3])).mean()
+        loss.backward()
+        o.step()
+        assert not np.allclose(before, l.weight.numpy()), make
+
+
+def test_lr_scheduler_updates():
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    l = nn.Linear(2, 1)
+    o = opt.SGD(learning_rate=sched, parameters=l.parameters())
+    assert abs(o.get_lr() - 0.1) < 1e-8
+    sched.step()
+    sched.step()
+    assert abs(o.get_lr() - 0.05) < 1e-8
+
+
+def test_grad_clip_global_norm():
+    l = nn.Linear(4, 4)
+    clip = nn.ClipGradByGlobalNorm(0.1)
+    o = opt.SGD(1.0, parameters=l.parameters(), grad_clip=clip)
+    (l(paddle.ones([2, 4])).sum() * 100).backward()
+    gn_before = np.sqrt(sum((p.grad.numpy() ** 2).sum() for p in l.parameters()))
+    assert gn_before > 0.1
+    before = l.weight.numpy().copy()
+    o.step()
+    # applied update norm == clipped grad norm (lr=1)
+    delta = np.sqrt(
+        ((before - l.weight.numpy()) ** 2).sum()
+        + ((0 - 0) ** 2)
+    )
+    assert delta <= 0.12
+
+
+def test_weight_decay_l2():
+    from paddle_tpu.regularizer import L2Decay
+
+    l = nn.Linear(2, 2, bias_attr=False)
+    o = opt.SGD(0.1, parameters=l.parameters(), weight_decay=L2Decay(0.5))
+    w0 = l.weight.numpy().copy()
+    out = l(paddle.zeros([1, 2])).sum()  # zero grad from data
+    out.backward()
+    o.step()
+    np.testing.assert_allclose(l.weight.numpy(), w0 - 0.1 * 0.5 * w0, rtol=1e-5)
+
+
+def test_static_mode_mlp_training(rng):
+    """The SURVEY §7 layer-3 milestone: static nn training end-to-end."""
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import program as fw
+        from paddle_tpu.framework.scope import Scope
+        from paddle_tpu.static.executor import Executor
+
+        main, startup = fw.Program(), fw.Program()
+        with fw.program_guard(main, startup):
+            x = main.global_block().create_var(
+                name="x", shape=(-1, 8), dtype="float32", is_data=True
+            )
+            y = main.global_block().create_var(
+                name="y", shape=(-1, 1), dtype="float32", is_data=True
+            )
+            net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+            pred = net(x)
+            loss = F.mse_loss(pred, y)
+            o = opt.Adam(0.01)
+            o.minimize(loss)
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        w = rng.randn(8, 1).astype("float32")
+        losses = []
+        for _ in range(30):
+            xb = rng.randn(64, 8).astype("float32")
+            (lv,) = exe.run(main, feed={"x": xb, "y": xb @ w},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.3, losses[::10]
+    finally:
+        paddle.disable_static()
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+    assert len(s) == 2
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_forward_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h = l.register_forward_post_hook(lambda layer, ins, out: calls.append(1))
+    l(paddle.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    l(paddle.ones([1, 2]))
+    assert calls == [1]
+
+
+def test_transformer_stack_unique_param_names():
+    enc_layer = nn.TransformerEncoderLayer(d_model=8, nhead=2, dim_feedforward=16)
+    enc = nn.TransformerEncoder(enc_layer, 3)
+    params = enc.parameters()
+    names = [p.name for p in params]
+    assert len(names) == len(set(names)), "deepcopy must regenerate param names"
+
+
+def test_cross_entropy_ignore_index_default():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([1, -100, 2, -100], "int64"))
+    loss = F.cross_entropy(logits, labels)
+    assert np.isfinite(loss.numpy()), "ignore_index=-100 must not NaN"
+    # mean over the 2 valid entries only
+    l_all = F.cross_entropy(logits, labels, reduction="none")
+    valid = l_all.numpy().reshape(-1)[[0, 2]]
+    np.testing.assert_allclose(loss.numpy(), valid.mean(), rtol=1e-5)
+
+
+def test_pad_4elem_and_pad2d_layer():
+    x = paddle.ones([2, 3, 4, 5])
+    y = F.pad(x, [1, 1, 2, 2])
+    assert y.shape == [2, 3, 8, 7]
+    y2 = F.pad(x, [1, 1, 2, 2], mode="reflect")
+    assert y2.shape == [2, 3, 8, 7]
+    layer = nn.Pad2D([1, 1, 2, 2])
+    assert layer(x).shape == [2, 3, 8, 7]
+
+
+def test_nll_loss_weight_and_ignore():
+    logp = F.log_softmax(paddle.randn([4, 3]))
+    labels = paddle.to_tensor(np.array([0, 1, 2, -100], "int64"))
+    w = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    loss = F.nll_loss(logp, labels, weight=w)
+    lp = logp.numpy()
+    expect = -(lp[0, 0] * 1 + lp[1, 1] * 2 + lp[2, 2] * 3) / (1 + 2 + 3)
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+
+def test_dropout2d_channelwise():
+    paddle.seed(3)
+    x = paddle.ones([2, 8, 4, 4])
+    y = F.dropout2d(x, p=0.5)
+    yn = y.numpy()
+    # each channel either fully zero or fully scaled
+    for n in range(2):
+        for c in range(8):
+            ch = yn[n, c]
+            assert (ch == 0).all() or (ch == 2.0).all()
+
+
+def test_embedding_negative_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=-1)
+    out = emb(paddle.to_tensor(np.array([9, 1], "int64")))
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+
+
+def test_layerlist_negative_setitem():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    new = nn.Linear(2, 2)
+    ll[-1] = new
+    assert len(ll) == 3
+    assert ll[2] is new
+
+
+def test_state_dict_excludes_sublayer_nonpersistable():
+    class Sub(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("tmp", paddle.ones([2]), persistable=False)
+            self.register_buffer("keep", paddle.ones([2]), persistable=True)
+
+    class Top(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.s = Sub()
+
+    top = Top()
+    sd = top.state_dict()
+    assert "s.keep" in sd and "s.tmp" not in sd
