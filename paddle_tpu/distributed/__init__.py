@@ -1,0 +1,19 @@
+"""``paddle.distributed`` — collective API + fleet over jax device meshes.
+
+Parity: ``/root/reference/python/paddle/distributed/`` (collective.py,
+parallel.py, fleet/).  SURVEY.md §2.4: the rendezvous + ring-id + comm-stream
+machinery of the reference maps to ``jax.distributed`` + mesh axes; the
+``c_*`` collective ops run inside pjit/shard_map over ICI.
+"""
+
+from .env import get_rank, get_world_size  # noqa: F401
+
+try:  # collective/fleet surfaces land with the distributed build stage
+    from .parallel import init_parallel_env, ParallelEnv  # noqa: F401
+    from .collective import (  # noqa: F401
+        all_gather, all_reduce, alltoall, barrier, broadcast, new_group,
+        recv, reduce, scatter, send, split, wait, ReduceOp,
+    )
+    from . import fleet  # noqa: F401
+except ImportError:  # pragma: no cover - during bring-up
+    pass

@@ -1,0 +1,34 @@
+"""Distributed environment introspection.
+
+Parity: ``/root/reference/python/paddle/distributed/parallel.py``
+(get_rank/get_world_size reading PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set
+by the launcher) — extended with jax.process_index for multi-host TPU pods.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_rank() -> int:
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    if r is not None:
+        return int(r)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    w = os.environ.get("PADDLE_TRAINERS_NUM")
+    if w is not None:
+        return int(w)
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1

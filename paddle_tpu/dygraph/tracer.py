@@ -190,8 +190,16 @@ def trace_fn(fn, tensors: List, name: str = "pyfunc"):
     Used for composite surface ops (indexing, custom PyLayer-like closures).
     Gradients come from ``jax.vjp`` of ``fn`` replayed at backward time —
     the dygraph analogue of the registry's auto-vjp grad ops.
+
+    In STATIC mode the closure is registered as a one-off op and appended to
+    the program (auto-vjp grads apply), so composite surface functions work
+    in both modes.
     """
     from .tensor import Tensor
+    from ..framework import program as fw
+
+    if not fw.in_dygraph_mode():
+        return _trace_fn_static(fn, tensors, name)
 
     arrays = [t._array for t in tensors]
     out_arrays = fn(*arrays)
@@ -205,6 +213,34 @@ def trace_fn(fn, tensors: List, name: str = "pyfunc"):
         for t in outs:
             t.grad_node = rec
     return outs[0] if single else outs
+
+
+_pyfunc_counter = [0]
+
+
+def _trace_fn_static(fn, tensors, name):
+    """Static-mode trace_fn: register the closure as a one-off op type and
+    append it to the current block (grads come from the auto-vjp maker)."""
+    from ..ops.dispatch import dispatch_static
+
+    _pyfunc_counter[0] += 1
+    op_type = f"__pyfunc_{name}_{_pyfunc_counter[0]}"
+
+    def kernel(kins, attrs):
+        xs = kins["X"]
+        if not isinstance(xs, list):
+            xs = [xs]
+        out = fn(*xs)
+        if isinstance(out, (list, tuple)):
+            return {"Out": list(out)}
+        return {"Out": [out]}
+
+    registry._REGISTRY[op_type] = registry.OpDef(
+        type=op_type, kernel=kernel, list_slots={"X", "Out"}
+    )
+    outs = dispatch_static(op_type, {"X": list(tensors)}, {})
+    res = outs["Out"]
+    return res[0] if len(res) == 1 else res
 
 
 class PyFuncRecord:

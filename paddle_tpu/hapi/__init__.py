@@ -1,0 +1,5 @@
+"""hapi — high-level API. Parity: ``/root/reference/python/paddle/hapi/``."""
+
+from .model import Model, InputSpec  # noqa: F401
+from . import callbacks  # noqa: F401
+from .progressbar import ProgressBar  # noqa: F401

@@ -1,0 +1,227 @@
+"""``paddle.Model`` — the Keras-like high-level trainer.
+
+Parity: ``/root/reference/python/paddle/hapi/model.py`` (``Model``:878,
+``prepare``:1450, ``fit``/``evaluate``/``predict``:304-area, save/load).
+Runs the dygraph engine (the 2.x default path); static acceleration comes
+from the whole-step jit in the underlying tracer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..dygraph.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
+from .progressbar import ProgressBar
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec (declares model inputs for save)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            metrics = []
+        elif isinstance(metrics, Metric):
+            metrics = [metrics]
+        self._metrics = list(metrics)
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        if not isinstance(outputs, (list, tuple)):
+            outputs = [outputs]
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        return self._loss(*outputs, *labels)
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels))
+        return loss
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..dygraph.base import no_grad
+
+        with no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels))
+        return loss
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..dygraph.base import no_grad
+
+        with no_grad():
+            return self.network(*inputs)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return list(batch[:-1]), batch[-1]
+            return [batch[0]], None
+        return [batch], None
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, num_workers)
+
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)]
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        if callbacks:
+            cbks.extend(callbacks)
+        cbk = CallbackList(cbks)
+        cbk.set_model(self)
+        steps = None
+        try:
+            steps = len(loader)
+        except TypeError:
+            pass
+        cbk.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+
+        cbk.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbk.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbk.on_train_batch_begin(step)
+                ins, label = self._split_batch(batch)
+                loss = self.train_batch(ins, label)
+                logs = {"loss": float(loss.numpy())}
+                for m in self._metrics:
+                    name = m.name()
+                    acc = m.accumulate()
+                    logs[name if isinstance(name, str) else name[0]] = (
+                        acc if not isinstance(acc, (list, tuple)) else acc[0]
+                    )
+                cbk.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbk.on_epoch_end(epoch, logs if steps else None)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
+                              num_workers=num_workers, callbacks=None)
+            if any(getattr(c, "stop_training", False) for c in cbks):
+                break
+            if num_iters is not None and it >= num_iters:
+                break
+        cbk.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for batch in loader:
+            ins, label = self._split_batch(batch)
+            loss = self.eval_batch(ins, label)
+            total_loss += float(loss.numpy())
+            n += 1
+        logs = {"loss": total_loss / max(n, 1)}
+        for m in self._metrics:
+            name = m.name()
+            logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+        if verbose:
+            print("Eval - " + " - ".join(f"{k}: {v}" for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            out = self.predict_batch(ins)
+            outputs.append(out.numpy() if hasattr(out, "numpy") else out)
+        if stack_outputs and outputs and isinstance(outputs[0], np.ndarray):
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from .. import io_api
+
+        io_api.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            io_api.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import io_api
+
+        state = io_api.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(io_api.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        lines = ["-" * 60]
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"{name:<40} {str(tuple(p.shape)):<15} {n}")
+        lines.append("-" * 60)
+        lines.append(f"Total params: {total}")
+        out = "\n".join(lines)
+        print(out)
+        return {"total_params": total}

@@ -1,0 +1,69 @@
+"""``paddle.save`` / ``paddle.load``.
+
+Parity: ``/root/reference/python/paddle/framework/io.py`` (pickle-based
+save/load of state_dicts, nested containers of Tensors, Layer/Optimizer
+state) and ``fluid/dygraph/checkpoint.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+def _to_saveable(obj: Any):
+    from .dygraph.tensor import Tensor
+    from .framework import program as fw
+    from .framework.scope import global_scope
+
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "value": np.asarray(obj.numpy()),
+                "name": obj.name, "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, fw.Variable):
+        val = global_scope().find_var(obj.name)
+        return {"__tensor__": True,
+                "value": np.asarray(val) if val is not None else None,
+                "name": obj.name, "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj: Any, return_numpy: bool):
+    from .dygraph.tensor import Tensor
+
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            val = obj["value"]
+            if return_numpy or val is None:
+                return val
+            return Tensor(val, stop_gradient=obj.get("stop_gradient", True),
+                          name=obj.get("name"))
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _from_saved(data, return_numpy)

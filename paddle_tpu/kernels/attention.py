@@ -24,8 +24,10 @@ import jax.numpy as jnp
 from ..ops.registry import register_op
 
 
-def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False):
-    """q,k,v: [..., seq, head_dim] (any leading batch/head dims)."""
+def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False,
+                    dropout_p=0.0, rng=None):
+    """q,k,v: [..., seq, head_dim] (any leading batch/head dims).  Dropout is
+    applied to the attention PROBABILITIES (paddle/reference semantics)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(s, q.dtype)
@@ -39,35 +41,40 @@ def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False):
         else:
             logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), jnp.zeros_like(probs))
     return jnp.einsum("...qk,...kd->...qd", probs, v)
 
 
-def sdpa(q, k, v, mask=None, scale=None, is_causal=False):
+def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0, rng=None):
     """Dispatch to the Pallas flash kernel on TPU when profitable, else the
-    XLA-fused reference."""
-    try:
-        from . import flash
+    XLA-fused reference (dropout always takes the reference path)."""
+    if dropout_p == 0.0:
+        try:
+            from . import flash
 
-        if flash.available() and mask is None and q.shape[-2] >= 512:
-            return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
-    except ImportError:
-        pass
-    return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal)
+            if flash.available() and mask is None and q.shape[-2] >= 512:
+                return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
+        except ImportError:
+            pass
+    return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal,
+                           dropout_p=dropout_p, rng=rng)
 
 
 @register_op("scaled_dot_product_attention", needs_rng=True)
 def sdpa_kernel(ins, attrs, rng=None):
     q, k, v = ins["Q"], ins["K"], ins["V"]
     mask = ins.get("Mask")
+    p = attrs.get("dropout_p", 0.0)
+    if attrs.get("is_test", False):
+        p = 0.0
     out = sdpa(
         q, k, v, mask=mask,
         scale=attrs.get("scale"),
         is_causal=attrs.get("is_causal", False),
+        dropout_p=p, rng=rng,
     )
-    p = attrs.get("dropout_p", 0.0)
-    if p > 0.0 and not attrs.get("is_test", False):
-        keep = jax.random.bernoulli(rng, 1.0 - p, out.shape)
-        out = jnp.where(keep, out / (1.0 - p), jnp.zeros_like(out))
     return {"Out": out}
 
 

@@ -196,10 +196,21 @@ def conv2d_transpose(
     stride = [stride] * 2 if isinstance(stride, int) else list(stride)
     dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
     padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+    output_padding = (
+        [output_padding] * 2 if isinstance(output_padding, int) else list(output_padding)
+    )
+    if output_size is not None:
+        # derive output_padding so the result hits the requested size exactly
+        os_ = [output_size] * 2 if isinstance(output_size, int) else list(output_size)
+        kh, kw = int(weight.shape[-2]), int(weight.shape[-1])
+        for i, (k, dim) in enumerate(zip((kh, kw), (2, 3))):
+            base = (int(x.shape[dim]) - 1) * stride[i] - 2 * padding[i] + dilation[i] * (k - 1) + 1
+            output_padding[i] = int(os_[i]) - base
     out = _d(
         "conv2d_transpose",
         {"Input": [x], "Filter": [weight]},
-        {"strides": stride, "paddings": padding, "dilations": dilation, "groups": groups},
+        {"strides": stride, "paddings": padding, "dilations": dilation,
+         "groups": groups, "output_padding": output_padding},
         slot="Output",
     )
     if bias is not None:
@@ -273,8 +284,12 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
          "data_layout": data_format,
          "use_global_stats": bool(use_global_stats) if use_global_stats is not None else False},
     )
-    # functionally update running stats (the Layer wrapper rebinds them)
-    return outs
+    # running stats are functional outputs; rebind in place (dygraph) so the
+    # caller's running_mean/var follow paddle's mutable semantics
+    if training and hasattr(running_mean, "_array"):
+        running_mean._array = outs["MeanOut"][0]._array
+        running_var._array = outs["VarianceOut"][0]._array
+    return outs["Y"][0]
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):

@@ -42,16 +42,20 @@ def calculate_gain(nonlinearity: str, param=None) -> float:
 
 
 def _fan_in_out(shape: Sequence[int]):
+    """Parity: ``_compute_fans`` in the reference's fluid/initializer.py —
+    FC weights are [in, out]; conv kernels are [out_c, in_c, kh, kw], so for
+    rank>2 fan_in uses shape[1] (input channels) times the receptive field."""
     shape = list(shape)
     if len(shape) < 2:
         fan_in = fan_out = shape[0] if shape else 1
+    elif len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
     else:
         receptive = 1
         for s in shape[2:]:
             receptive *= s
-        # paddle convention: fan_in = shape[0]*receptive? For FC (in,out):
-        fan_in = shape[0] * receptive
-        fan_out = shape[1] * receptive
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
     return fan_in, fan_out
 
 

@@ -67,17 +67,24 @@ class Dropout(Layer):
     def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
         super().__init__()
         self.p = p
+        self.axis = axis
         self.mode = mode
 
     def forward(self, x):
-        return F.dropout(x, p=self.p, training=self.training, mode=self.mode)
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training, mode=self.mode)
 
     def extra_repr(self):
         return f"p={self.p}"
 
 
-class Dropout2D(Dropout):
-    pass
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training, data_format=self.data_format)
 
 
 class Flatten(Layer):

@@ -54,6 +54,7 @@ class Conv2DTranspose(Layer):
         super().__init__()
         self._stride = _pair(stride)
         self._padding = padding
+        self._output_padding = output_padding
         self._dilation = _pair(dilation)
         self._groups = groups
         ks = _pair(kernel_size)
@@ -65,6 +66,7 @@ class Conv2DTranspose(Layer):
     def forward(self, x, output_size=None):
         return F.conv2d_transpose(
             x, self.weight, self.bias, stride=self._stride, padding=self._padding,
+            output_padding=self._output_padding if output_size is None else 0,
             dilation=self._dilation, groups=self._groups, output_size=output_size,
         )
 

@@ -89,10 +89,17 @@ def conv2d_transpose_kernel(ins, attrs):
         pad = [(p[0], p[0]), (p[1], p[1])]
     else:
         pad = [(p[0], p[1]), (p[2], p[3])]
-    # conv_transpose: lhs_dilation = strides, padding adjusted
+    # conv_transpose: lhs_dilation = strides, padding adjusted; output_padding
+    # extends the high side (parity: conv2d_transpose_op output_padding attr)
+    out_pad = attrs.get("output_padding", [0, 0]) or [0, 0]
+    if isinstance(out_pad, int):
+        out_pad = [out_pad, out_pad]
     kh, kw = w.shape[-2:]
     adj_pad = [
-        (dilations[i] * (k - 1) - pad[i][0], dilations[i] * (k - 1) - pad[i][1])
+        (
+            dilations[i] * (k - 1) - pad[i][0],
+            dilations[i] * (k - 1) - pad[i][1] + out_pad[i],
+        )
         for i, k in enumerate((kh, kw))
     ]
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
@@ -134,14 +141,22 @@ def pool2d_kernel(ins, attrs):
         pad = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
     window = (1, 1, ksize[0], ksize[1])
     strides4 = (1, 1, strides[0], strides[1])
+    import numpy as np
+
+    # init values MUST be numpy literals: jnp.asarray-wrapped inits become
+    # tracers under jit and reduce_window's linearization then fails
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        out = jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max, window, strides4, pad)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = np.array(-np.inf, x.dtype)
+        else:
+            init = np.array(np.iinfo(x.dtype).min, x.dtype)
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, pad)
     else:
-        s = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add, window, strides4, pad)
+        zero = np.array(0, x.dtype)
+        s = jax.lax.reduce_window(x, zero, jax.lax.add, window, strides4, pad)
         if attrs.get("exclusive", True) and any(pi != (0, 0) for pi in pad):
             ones = jnp.ones_like(x)
-            cnt = jax.lax.reduce_window(ones, jnp.asarray(0, x.dtype), jax.lax.add, window, strides4, pad)
+            cnt = jax.lax.reduce_window(ones, zero, jax.lax.add, window, strides4, pad)
             out = s / cnt
         else:
             out = s / (ksize[0] * ksize[1])

@@ -41,10 +41,15 @@ class Optimizer:
         self._parameter_list = list(parameters) if parameters is not None else None
         from ..regularizer import L2Decay
 
-        if isinstance(weight_decay, float):
-            self.regularization = L2Decay(weight_decay)
+        if isinstance(weight_decay, (int, float)) and not isinstance(weight_decay, bool):
+            self.regularization = L2Decay(float(weight_decay))
         else:
             self.regularization = weight_decay
+        if isinstance(learning_rate, LRScheduler):
+            bound = getattr(learning_rate, "_bound_optimizers", None)
+            if bound is None:
+                bound = learning_rate._bound_optimizers = []
+            bound.append(self)
         self._grad_clip = grad_clip
         # accumulators: acc_name -> param_name -> Tensor (dygraph) / Variable (static)
         self._accumulators: Dict[str, Dict[str, object]] = {}
@@ -297,7 +302,10 @@ class AdamW(Adam):
                  multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, name)
-        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        if isinstance(weight_decay, (int, float)) and not isinstance(weight_decay, bool):
+            self._coeff = float(weight_decay)
+        else:
+            self._coeff = 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
 
     _op = "adamw"

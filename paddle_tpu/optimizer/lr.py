@@ -36,6 +36,10 @@ class LRScheduler:
         else:
             self.last_epoch = epoch
         self.last_lr = self.get_lr()
+        # static-mode optimizers bind themselves here so scheduler steps
+        # propagate into the scope's lr variable
+        for o in getattr(self, "_bound_optimizers", []):
+            o._sync_static_lr()
         if self.verbose:
             print(f"Epoch {self.last_epoch}: lr set to {self.last_lr}")
 

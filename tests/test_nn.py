@@ -303,3 +303,75 @@ def test_state_dict_excludes_sublayer_nonpersistable():
     top = Top()
     sd = top.state_dict()
     assert "s.keep" in sd and "s.tmp" not in sd
+
+
+def test_conv2d_transpose_output_padding():
+    l = nn.Conv2DTranspose(2, 3, 3, stride=2, padding=1, output_padding=1)
+    out = l(paddle.randn([1, 2, 8, 8]))
+    assert out.shape == [1, 3, 16, 16]
+    out2 = l(paddle.randn([1, 2, 8, 8]), output_size=[15, 15])
+    assert out2.shape == [1, 3, 15, 15]
+
+
+def test_functional_batch_norm_returns_tensor():
+    x = paddle.randn([4, 3, 2, 2])
+    rm = paddle.zeros([3]); rv = paddle.ones([3])
+    w = paddle.ones([3]); b = paddle.zeros([3])
+    y = F.batch_norm(x, rm, rv, w, b, training=True)
+    assert y.shape == [4, 3, 2, 2]
+    assert not np.allclose(rm.numpy(), 0)  # running stats updated in place
+
+
+def test_static_mode_trace_fn_ops():
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import program as fw
+        from paddle_tpu.framework.scope import Scope
+        from paddle_tpu.static.executor import Executor
+
+        main = fw.Program()
+        with fw.program_guard(main, fw.Program()):
+            x = main.global_block().create_var(name="x", shape=(2, 8), dtype="float32", is_data=True)
+            y = F.maxout(x, groups=2, axis=1)
+            assert tuple(y.shape) == (2, 4)
+        exe = Executor()
+        xv = np.arange(16, dtype="float32").reshape(2, 8)
+        (res,) = exe.run(main, feed={"x": xv}, fetch_list=[y], scope=Scope())
+        np.testing.assert_allclose(res, np.maximum(xv.reshape(2, 4, 2)[:, :, 0], xv.reshape(2, 4, 2)[:, :, 1]).reshape(2, 4))
+    finally:
+        paddle.disable_static()
+
+
+def test_nn_dropout2d_layer_channelwise():
+    paddle.seed(5)
+    l = nn.Dropout2D(0.5)
+    y = l(paddle.ones([2, 8, 4, 4])).numpy()
+    for n in range(2):
+        for c in range(8):
+            ch = y[n, c]
+            assert (ch == 0).all() or (ch == 2.0).all()
+
+
+def test_static_lr_scheduler_syncs_scope():
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import program as fw
+        from paddle_tpu.framework.scope import Scope, global_scope
+        from paddle_tpu.static.executor import Executor
+
+        main, startup = fw.Program(), fw.Program()
+        with fw.program_guard(main, startup):
+            x = main.global_block().create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+            l = nn.Linear(2, 1)
+            loss = l(x).mean()
+            sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+            o = opt.SGD(learning_rate=sched)
+            o.minimize(loss)
+        exe = Executor()
+        exe.run(startup)
+        lr_name = o._lr_var.name
+        sched.step()
+        got = float(np.asarray(global_scope().find_var(lr_name)))
+        assert abs(got - 0.01) < 1e-8
+    finally:
+        paddle.disable_static()
