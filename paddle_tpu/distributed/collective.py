@@ -1,0 +1,273 @@
+"""``paddle.distributed`` collective API.
+
+Parity: ``/root/reference/python/paddle/distributed/collective.py``
+(all_reduce, all_gather, broadcast, reduce, scatter, alltoall, send/recv,
+barrier, new_group:209, split:1283, _c_identity:748, _mp_allreduce:882).
+
+TPU-first semantics (SURVEY.md §2.4):
+  * a Group names a MESH AXIS (ring_id -> axis registered with the kernel
+    layer), so collectives called while tracing under shard_map lower to
+    lax.psum / all_gather / ppermute on ICI;
+  * called eagerly on global (sharded or replicated) jax arrays, data is
+    already globally consistent — the cross-RANK part degenerates to the
+    cross-PROCESS case, served by multihost utils when process_count > 1;
+  * in static mode the call appends the corresponding ``c_*`` op, preserving
+    the reference's program-rewriting architecture.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework import program as fw
+from ..ops.dispatch import dispatch, single
+from ..ops import collective_ops
+from . import env as dist_env
+from . import mesh as mesh_mod
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "broadcast", "reduce", "scatter", "alltoall", "send", "recv", "barrier",
+    "wait", "split", "get_rank", "get_world_size", "is_initialized",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+class Group:
+    """Parity: collective.py Group — here bound to a mesh axis name."""
+
+    def __init__(self, rank: int, nranks: int, id: int = 0,
+                 ranks: Optional[List[int]] = None, axis_name: Optional[str] = None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name
+        if axis_name is not None:
+            collective_ops.set_ring_axis(id, axis_name)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axis={self.axis_name})"
+
+
+_GROUPS = {}
+_GROUP_COUNTER = [0]
+
+
+def _default_group() -> Group:
+    if 0 not in _GROUPS:
+        _GROUPS[0] = Group(
+            dist_env.get_rank(), max(dist_env.get_world_size(), 1), 0,
+            axis_name=None,
+        )
+    return _GROUPS[0]
+
+
+def get_group(gid: int = 0) -> Group:
+    return _GROUPS.get(gid) or _default_group()
+
+
+def is_initialized() -> bool:
+    return True
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None, axis_name=None) -> Group:
+    """Parity: collective.py:209 new_group — allocates a ring id; here the
+    ring is (optionally) bound to a mesh axis for in-graph collectives."""
+    _GROUP_COUNTER[0] += 1
+    gid = _GROUP_COUNTER[0]
+    rank = dist_env.get_rank()
+    ranks = ranks if ranks is not None else list(range(dist_env.get_world_size()))
+    g = Group(ranks.index(rank) if rank in ranks else -1, len(ranks), gid,
+              ranks=ranks, axis_name=axis_name)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_rank():
+    return dist_env.get_rank()
+
+
+def get_world_size():
+    return dist_env.get_world_size()
+
+
+def _ring(group) -> int:
+    return 0 if group is None else group.id
+
+
+def _is_static() -> bool:
+    return not fw.in_dygraph_mode()
+
+
+def _eager_value(tensor):
+    return tensor
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True, sync_op=True):
+    op_type = {
+        ReduceOp.SUM: "c_allreduce_sum", ReduceOp.MAX: "c_allreduce_max",
+        ReduceOp.MIN: "c_allreduce_min", ReduceOp.PROD: "c_allreduce_prod",
+    }[op]
+    out = single(dispatch(op_type, {"X": [tensor]}, {"ring_id": _ring(group)}))
+    if not _is_static():
+        # in-place semantics (parity: reference mutates the input tensor)
+        tensor._array = out._array
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    out = single(dispatch("c_allgather", {"X": [tensor]},
+                          {"ring_id": _ring(group),
+                           "nranks": (group or _default_group()).nranks}))
+    if not _is_static():
+        n = (group or _default_group()).nranks
+        if n <= 1:
+            tensor_list.append(out)
+        else:
+            from .. import tensor_api as T
+
+            chunks = T.split(out, n, axis=0)
+            tensor_list.extend(chunks)
+        return
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    out = single(dispatch("c_broadcast", {"X": [tensor]},
+                          {"ring_id": _ring(group), "root": src}))
+    if not _is_static():
+        tensor._array = out._array
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on mesh axes reduce==allreduce (every shard gets the value); parity ok
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    n = (group or _default_group()).nranks
+    if n <= 1:
+        if tensor_list:
+            tensor._array = tensor_list[0]._array
+        return tensor
+    raise NotImplementedError(
+        "eager scatter across ranks is expressed by sharding the source "
+        "array over the mesh (paddle_tpu.distributed.mesh.shard_batch)"
+    )
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    if isinstance(in_tensor_list, (list, tuple)):
+        n = (group or _default_group()).nranks
+        if n <= 1:
+            out_tensor_list.extend(in_tensor_list)
+            return
+        raise NotImplementedError(
+            "eager list-based alltoall across ranks maps to mesh resharding; "
+            "inside shard_map use the 'alltoall' op"
+        )
+    return single(dispatch("alltoall", {"X": [in_tensor_list]}, {"ring_id": _ring(group)}))
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    if (group or _default_group()).nranks <= 1:
+        return
+    raise NotImplementedError(
+        "p2p send/recv maps to ppermute inside the pipeline engine "
+        "(paddle_tpu.distributed.fleet pipeline parallel)"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if (group or _default_group()).nranks <= 1:
+        return
+    raise NotImplementedError(
+        "p2p send/recv maps to ppermute inside the pipeline engine "
+        "(paddle_tpu.distributed.fleet pipeline parallel)"
+    )
+
+
+def barrier(group=None):
+    import jax
+
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if hasattr(tensor, "_array"):
+        tensor._array.block_until_ready()
+
+
+# -- model-parallel helpers (parity: collective.py:748-1283) -----------------
+
+
+def _c_identity(tensor, group=None):
+    return single(dispatch("c_identity", {"X": [tensor]}, {"ring_id": _ring(group)}))
+
+
+def _mp_allreduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    return single(dispatch("mp_allreduce_sum", {"X": [tensor]}, {"ring_id": _ring(group)}))
+
+
+def _c_concat(tensor, group=None):
+    g = group or _default_group()
+    return single(dispatch("c_concat", {"X": [tensor]},
+                           {"ring_id": _ring(group), "nranks": g.nranks}))
+
+
+def _c_split(tensor, group=None):
+    g = group or _default_group()
+    return single(dispatch("c_split", {"X": [tensor]},
+                           {"ring_id": _ring(group), "nranks": g.nranks}))
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None, return_softmax=False):
+    outs = dispatch(
+        "c_softmax_with_cross_entropy",
+        {"Logits": [logits], "Label": [label]},
+        {"ring_id": _ring(group)},
+    )
+    if return_softmax:
+        return outs["Loss"][0], outs["Softmax"][0]
+    return outs["Loss"][0]
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity: collective.py:1283 paddle.distributed.split — builds a
+    row/column-sharded linear or vocab-sharded embedding."""
+    from .fleet import meta_parallel as mpp
+
+    if operation == "embedding":
+        layer = mpp.VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = mpp.RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                          has_bias=bias_attr is not False)
+        else:
+            layer = mpp.ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                             has_bias=bias_attr is not False,
+                                             gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r}")

@@ -1,0 +1,179 @@
+"""``paddle.distributed.fleet``.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/__init__.py`` +
+``base/fleet_base.py`` (``init``:139, ``distributed_model``:836,
+``distributed_optimizer``:783, worker/server accessors).  The parameter-server
+mode is explicitly out of scope (BASELINE north star) — PS entry points raise
+with a pointer to the collective path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .. import env as dist_env
+from .. import mesh as mesh_mod
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import meta_parallel  # noqa: F401
+from ..parallel import init_parallel_env
+
+__all__ = [
+    "init", "DistributedStrategy", "UserDefinedRoleMaker", "PaddleCloudRoleMaker",
+    "worker_index", "worker_num", "is_worker", "worker_endpoints", "server_num",
+    "server_index", "server_endpoints", "is_server", "is_first_worker", "barrier_worker",
+    "init_worker", "init_server", "run_server", "stop_worker", "distributed_model",
+    "distributed_optimizer", "get_hybrid_communicate_group", "meta_parallel",
+]
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+    "is_collective": True,
+}
+
+
+class PaddleCloudRoleMaker:
+    """Parity: fleet/base/role_maker.py — env-driven role discovery."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        return dist_env.get_rank()
+
+    def _worker_num(self):
+        return dist_env.get_world_size()
+
+
+UserDefinedRoleMaker = PaddleCloudRoleMaker
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    """Parity: fleet_base.py:139 fleet.init."""
+    if strategy is None:
+        strategy = DistributedStrategy()
+    _fleet_state["strategy"] = strategy
+    _fleet_state["is_collective"] = is_collective
+    init_parallel_env()
+
+    hc = strategy.hybrid_configs
+    dp, mp = hc.get("dp_degree", -1), hc.get("mp_degree", 1)
+    pp, sd = hc.get("pp_degree", 1), hc.get("sharding_degree", 1)
+    import jax
+
+    ndev = len(jax.devices())
+    if dp in (-1, 0, None):
+        dp = max(ndev // max(mp * pp * sd, 1), 1)
+    topo = CommunicateTopology(dims=(dp, pp, sd, mp))
+    _fleet_state["hcg"] = HybridCommunicateGroup(topo)
+    _fleet_state["initialized"] = True
+    return None
+
+
+def _hcg() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        init()
+    return _fleet_state["hcg"]
+
+
+def get_hybrid_communicate_group():
+    return _hcg()
+
+
+def distributed_model(model):
+    """Parity: fleet_base.py:836 — wrap by parallel mode."""
+    hcg = _hcg()
+    strategy = _fleet_state["strategy"]
+    mode = hcg.get_parallel_mode()
+    mp_cls = meta_parallel
+    if mode == "pipeline_parallel":
+        return mp_cls.PipelineParallel(model, hcg, strategy)
+    if mode == "tensor_parallel":
+        return mp_cls.TensorParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return mp_cls.ShardingParallel(model, hcg, strategy)
+    return mp_cls.DataParallelSPMD(model, hcg, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Parity: fleet_base.py:783."""
+    if strategy is not None:
+        _fleet_state["strategy"] = strategy
+    return meta_parallel.HybridParallelOptimizer(
+        optimizer, _hcg(), _fleet_state["strategy"] or DistributedStrategy()
+    )
+
+
+# -- worker/server accessors (collective mode) ------------------------------
+
+
+def worker_index():
+    return dist_env.get_rank()
+
+
+def worker_num():
+    return dist_env.get_world_size()
+
+
+def is_worker():
+    return True
+
+
+def is_first_worker():
+    return dist_env.get_rank() == 0
+
+
+def worker_endpoints(to_string=False):
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from .. import collective
+
+    collective.barrier()
+
+
+# -- parameter-server path: explicitly out of scope -------------------------
+
+_PS_MSG = (
+    "the parameter-server path is out of scope for the TPU build (BASELINE "
+    "north star: 'the parameter-server path is left untouched'); use the "
+    "collective path — sparse tables map to mesh-sharded embeddings "
+    "(meta_parallel.VocabParallelEmbedding)"
+)
+
+
+def init_server(*a, **k):
+    raise NotImplementedError(_PS_MSG)
+
+
+def run_server(*a, **k):
+    raise NotImplementedError(_PS_MSG)
+
+
+def init_worker(*a, **k):  # collective mode: nothing to do
+    return None
+
+
+def stop_worker(*a, **k):
+    return None
+
+
+def server_num():
+    return 0
+
+
+def server_index():
+    return 0
+
+
+def server_endpoints(to_string=False):
+    return "" if to_string else []
+
+
+def is_server():
+    return False

@@ -1,0 +1,11 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RNGStatesTracker,
+    RowParallelLinear, VocabParallelEmbedding, get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: F401
+from .pipeline_engine import PipelineEngine, spmd_pipeline  # noqa: F401
+from .parallel_wrappers import (  # noqa: F401
+    DataParallelSPMD, PipelineParallel, ShardingParallel, TensorParallel,
+)
+from .sharding_optimizer import DygraphShardingOptimizer, HybridParallelOptimizer  # noqa: F401

@@ -1,0 +1,182 @@
+"""Megatron tensor-parallel layers.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py`` — ``VocabParallelEmbedding``:30,
+``ColumnParallelLinear``:97, ``RowParallelLinear``:170,
+``ParallelCrossEntropy``:249 — and ``random.py:24`` RNGStatesTracker.
+
+TPU-first: parameters carry a NamedSharding over the 'mp' mesh axis (GSPMD).
+Eagerly and under jit, XLA propagates the shardings and inserts the identity/
+allreduce pair the reference builds explicitly with c_identity /
+c_allreduce_sum ops; under shard_map the same layers lower through the
+``c_*`` kernels with named-axis collectives.  Either way the collectives ride
+ICI — no NCCL rings (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....framework import program as fw
+from ....nn import functional as F
+from ....nn.layer_base import Layer
+from ....nn.initializer import Constant, Normal, XavierUniform
+from .... import tensor_api as T
+from ... import mesh as mesh_mod
+
+
+def _place(param, *spec):
+    """Attach a mesh sharding to an eager parameter (no-op in static mode or
+    without a multi-device mesh)."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or not fw.in_dygraph_mode() or param is None:
+        return param
+    names = [s for s in spec if s is not None]
+    if any(mesh.shape.get(n, 1) > 1 for n in names) or not names:
+        param._array = jax.device_put(param._array, NamedSharding(mesh, P(*spec)))
+    return param
+
+
+def _mp_degree() -> int:
+    return mesh_mod.axis_size("mp")
+
+
+class VocabParallelEmbedding(Layer):
+    """Rows (vocab dim) sharded over 'mp' (mp_layers.py:30 parity)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 0.02),
+        )
+        _place(self.weight, "mp", None)
+        self.weight.is_distributed = _mp_degree() > 1
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Weight columns (output dim) sharded over 'mp' (mp_layers.py:97)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        _place(self.weight, None, "mp")
+        self.weight.is_distributed = _mp_degree() > 1
+        self.bias = (
+            self.create_parameter(shape=[out_features], attr=None, is_bias=True)
+            if has_bias else None
+        )
+        _place(self.bias, "mp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and _mp_degree() > 1 and fw.in_dygraph_mode():
+            mesh = mesh_mod.get_mesh()
+            out._array = jax.device_put(out._array, NamedSharding(mesh, P()))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight rows (input dim) sharded over 'mp'; the contraction over the
+    sharded dim makes XLA emit the allreduce (mp_layers.py:170)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform(),
+        )
+        _place(self.weight, "mp", None)
+        self.weight.is_distributed = _mp_degree() > 1
+        self.bias = (
+            self.create_parameter(shape=[out_features], attr=None, is_bias=True)
+            if has_bias else None
+        )
+        _place(self.bias)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax+CE (mp_layers.py:249; kernel parity:
+    c_softmax_with_cross_entropy_op.cu)."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+        self._group = mp_group
+
+    def forward(self, input, label):
+        from ....ops.dispatch import dispatch
+
+        ring = self._group.id if self._group is not None else 0
+        outs = dispatch(
+            "c_softmax_with_cross_entropy",
+            {"Logits": [input], "Label": [label]},
+            {"ring_id": ring},
+        )
+        return outs["Loss"][0]
+
+
+# -- RNG state tracker (random.py:24 parity) --------------------------------
+
+
+class RNGStatesTracker:
+    """Named RNG states so dropout inside/outside TP regions decorrelates per
+    mp rank (parity: fleet/meta_parallel/parallel_layers/random.py)."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def add(self, name, seed):
+        import jax
+
+        self.states_[name] = jax.random.PRNGKey(int(seed))
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        from ....framework import random as fr
+
+        if name not in self.states_:
+            self.add(name, np.random.randint(0, 2**31))
+        old = fr.get_rng_state()
+        fr.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = fr.get_rng_state()
+            fr.set_rng_state(old)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import numpy as _np
+
+    seed = seed or _np.random.randint(0, 2**31)
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("model_parallel_rng", seed)

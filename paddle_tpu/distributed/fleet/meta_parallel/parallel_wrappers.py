@@ -1,0 +1,162 @@
+"""Model wrappers selected by ``fleet.distributed_model``.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/meta_parallel/
+{tensor_parallel.py, pipeline_parallel.py, sharding_parallel.py}`` and
+``fleet_base.py:836`` wrapper selection.
+
+TPU-first semantics: data/tensor/sharding parallelism are expressed as
+ARRAY SHARDINGS on the hybrid mesh — forward code is unchanged and XLA
+inserts the collectives (no Reducer, no bucketed allreduce: gradients of
+replicated params over sharded batches psum automatically).  Pipeline
+parallelism routes train_batch through the shard_map 1F1B engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn.layer_base import Layer
+from ....dygraph.tensor import Tensor
+from ... import mesh as mesh_mod
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class DataParallelSPMD(MetaParallelBase):
+    """DP by batch sharding: replicate params, shard inputs on 'dp'.
+
+    Role parity: dygraph DataParallel + C++ Reducer
+    (``imperative/reducer.cc`` bucketed overlapped allreduce) — unnecessary
+    under XLA: the grad of a replicated param w.r.t. a dp-sharded batch IS a
+    psum, inserted and overlapped by the compiler (SURVEY.md §7 layer 6).
+    """
+
+    def _prepare_for_model(self):
+        mesh = mesh_mod.get_mesh()
+        if mesh is None:
+            return
+        repl = NamedSharding(mesh, P())
+        for p in self._layers.parameters():
+            if isinstance(p, Tensor) and not getattr(p, "is_distributed", False):
+                p._array = jax.device_put(p._array, repl)
+
+    def forward(self, *inputs, **kwargs):
+        ins = [
+            Tensor(mesh_mod.shard_batch(i._array if isinstance(i, Tensor) else np.asarray(i)),
+                   stop_gradient=getattr(i, "stop_gradient", True))
+            if isinstance(i, (Tensor, np.ndarray)) else i
+            for i in inputs
+        ]
+        return self._layers(*ins, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # grads are exact global means already
+
+    def apply_collective_grads(self):
+        pass  # XLA inserted the reductions in backward
+
+
+class TensorParallel(DataParallelSPMD):
+    """TP: mp_layers carry 'mp' shardings; batch still shards over 'dp'."""
+
+
+class ShardingParallel(DataParallelSPMD):
+    """ZeRO-style sharding: optimizer-state sharding is applied by
+    DygraphShardingOptimizer; param placement stays replicated here."""
+
+
+class PipelineParallel(MetaParallelBase):
+    """paddle PipelineParallel API over the shard_map 1F1B engine."""
+
+    def __init__(self, layers, hcg, strategy=None, loss_fn=None):
+        super().__init__(layers, hcg, strategy)
+        self._engine = None
+        self._loss_fn = loss_fn or getattr(layers, "_loss_fn", None)
+        acc = 1
+        if strategy is not None:
+            acc = strategy.pipeline_configs.get("accumulate_steps", 1)
+        self.accumulate_steps = acc
+
+    def _get_engine(self):
+        if self._engine is None:
+            from .pipeline_engine import PipelineEngine
+
+            self._engine = PipelineEngine(self._layers, loss_fn=self._loss_fn)
+        return self._engine
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
+        """Parity: pipeline_parallel.py:114 train_batch — splits data into
+        microbatches, runs pipelined fwd+bwd, applies the optimizer."""
+        x, y = data
+        xa = x._array if isinstance(x, Tensor) else np.asarray(x)
+        ya = y._array if isinstance(y, Tensor) else np.asarray(y)
+        M = max(self.accumulate_steps, 1)
+        assert xa.shape[0] % M == 0, (
+            f"batch {xa.shape[0]} must divide into accumulate_steps={M}"
+        )
+        import jax.numpy as jnp
+
+        xs = jnp.reshape(xa, (M, xa.shape[0] // M) + xa.shape[1:])
+        ys = jnp.reshape(ya, (M, ya.shape[0] // M) + ya.shape[1:])
+        engine = self._get_engine()
+
+        def loss_fn(out_mb, y_mb):
+            # user loss works on Tensors; run it untaped on the traced arrays
+            from ....dygraph import tracer
+
+            lf = self._loss_fn
+            old = tracer.set_grad_enabled(False)
+            try:
+                res = lf(Tensor(out_mb, stop_gradient=True),
+                         Tensor(y_mb, stop_gradient=True))
+                return res._array if isinstance(res, Tensor) else res
+            finally:
+                tracer.set_grad_enabled(old)
+
+        loss, grads = engine.forward_backward(xs, ys, loss_fn)
+        lr = optimizer.get_lr() if optimizer is not None else 1e-3
+        engine.apply_grads_sgd(grads, lr)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss, stop_gradient=True)
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
+        if compute_loss and self._loss_fn is not None:
+            return self._loss_fn(out, y)
+        return out

@@ -1,0 +1,204 @@
+"""SPMD pipeline-parallel engine: 1F1B-style microbatch schedule compiled as
+ONE XLA program over the 'pp' mesh axis.
+
+Role parity: ``/root/reference/python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py`` (``PipelineParallel.train_batch``:114, ``_forward``:156,
+``_backward``:199) and its NCCL p2p transport
+(``pp_utils/p2p_communication.py:38-130``).
+
+TPU-first design (SURVEY.md §7 "hard parts"):
+  * stage transfer = ``lax.ppermute`` over the 'pp' ICI axis inside
+    ``shard_map`` — no send_v2/recv_v2 ops, no comm streams;
+  * the whole microbatch loop is a ``lax.scan`` in ONE jitted program, so XLA
+    overlaps the ppermute with the next microbatch's compute (the 1F1B
+    overlap the reference schedules by hand);
+  * backward is ``jax.grad`` THROUGH the scan — no hand-written 1B phase;
+  * stage weights live as stacked arrays ``(S, ...)`` sharded over 'pp', so
+    each device holds exactly its stage's weights (pp memory scaling).
+
+Requires homogeneous stages (same param structure per stage) — the shape
+GPT/BERT stacks have.  Prologue (embedding) and epilogue (head/loss) run
+replicated outside the pipelined region (cheap relative to the blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ... import mesh as mesh_mod
+
+
+def spmd_pipeline(stage_fn: Callable, num_stages: int, axis: str = "pp"):
+    """Build a pipelined apply: ``(stacked_params, microbatches) -> outputs``.
+
+    stage_fn(params, x) -> y must be jax-traceable with y.shape == x.shape
+    (transformer blocks).  ``stacked_params`` is a pytree whose leaves have a
+    leading stage dim (S, ...); ``microbatches`` has shape (M, mb, ...).
+
+    The returned function is meant to be called INSIDE shard_map/jit with the
+    mesh installed; it handles its own shard_map over the pp axis.
+    """
+
+    mesh = mesh_mod.get_mesh()
+    S = num_stages
+
+    def per_device(params_block, xs):
+        # params_block leaves: (1, ...) — this device's stage params
+        stage = lax.axis_index(axis)
+        p = jax.tree_util.tree_map(lambda a: a[0], params_block)
+        M = xs.shape[0]
+        T = M + S - 1
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t; other stages use the received act
+            mb = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), keepdims=False)
+            x_in = jnp.where(stage == 0, mb, state)
+            y = stage_fn(p, x_in)
+            # last stage emits microbatch t-(S-1)
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            valid = (stage == S - 1) & (t >= S - 1)
+            cur = lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, cur), out_idx, axis=0
+            )
+            state = lax.ppermute(y, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(T))
+        # replicate the last stage's outputs across the pp axis
+        outputs = lax.psum(jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)), axis)
+        return outputs
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    def apply(stacked_params, microbatches):
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        try:
+            fn = shard_map(
+                per_device, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+                check_vma=False,
+            )
+        except TypeError:
+            fn = shard_map(
+                per_device, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+                check_rep=False,
+            )
+        return fn(stacked_params, microbatches)
+
+    return apply
+
+
+class PipelineEngine:
+    """Owns the stacked stage params + the compiled train step.
+
+    Exposed through ``PipelineParallel`` (paddle train_batch API parity).
+    """
+
+    def __init__(self, pipeline_layer, loss_fn=None, prologue=None, epilogue=None,
+                 axis: str = "pp"):
+        from .pp_layers import PipelineLayer
+
+        self.layers = pipeline_layer
+        self.axis = axis
+        self.mesh = mesh_mod.get_mesh()
+        self.S = pipeline_layer.get_num_stages()
+        self.loss_fn = loss_fn or pipeline_layer._loss_fn
+        self._stage_modules = [
+            [l for l, _ in pipeline_layer.stage_layers(s)] for s in range(self.S)
+        ]
+        self._flatten_stage_params()
+        self._train_step = None
+
+    # -- parameter management -------------------------------------------
+    def _stage_param_objs(self, s):
+        out = []
+        for m in self._stage_modules[s]:
+            if hasattr(m, "parameters"):
+                out.extend(m.parameters())
+        return out
+
+    def _flatten_stage_params(self):
+        per_stage = [self._stage_param_objs(s) for s in range(self.S)]
+        structs = [[tuple(p.shape) for p in ps] for ps in per_stage]
+        if any(st != structs[0] for st in structs[1:]):
+            raise ValueError(
+                "SPMD pipeline requires homogeneous stages (same param "
+                f"structure per stage); got {structs}"
+            )
+        self._param_objs = per_stage
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        self.stacked = [
+            jax.device_put(
+                jnp.stack([np.asarray(per_stage[s][i]._array) for s in range(self.S)]),
+                sharding,
+            )
+            for i in range(len(per_stage[0]))
+        ]
+
+    def sync_to_layers(self):
+        """Write the engine's (possibly updated) stacked params back into the
+        layer objects (for state_dict/save)."""
+        for i, arr in enumerate(self.stacked):
+            host = np.asarray(arr)
+            for s in range(self.S):
+                self._param_objs[s][i]._array = jnp.asarray(host[s])
+
+    # -- functional stage apply ------------------------------------------
+    def _stage_fn(self, params_list, x):
+        """Run one stage's modules functionally (swap arrays, no taping)."""
+        from ....dygraph import tracer
+        from ....dygraph.tensor import Tensor
+
+        mods = self._stage_modules[0]  # homogeneous: stage 0 structure
+        objs = self._param_objs[0]
+        old = [p._array for p in objs]
+        for p, a in zip(objs, params_list):
+            p._array = a
+        old_grad = tracer.set_grad_enabled(False)
+        try:
+            t = Tensor(x, stop_gradient=True)
+            for m in mods:
+                t = m(t) if not isinstance(t, tuple) else m(*t)
+            return t._array
+        finally:
+            tracer.set_grad_enabled(old_grad)
+            for p, a in zip(objs, old):
+                p._array = a
+
+    # -- compiled step ----------------------------------------------------
+    def build_forward(self):
+        apply = spmd_pipeline(
+            lambda p, x: self._stage_fn(p, x), self.S, self.axis
+        )
+        return apply
+
+    def forward_backward(self, microbatches, labels_mb, loss_fn):
+        """Returns (loss, grads_stacked).  loss_fn(y, label) -> scalar."""
+        apply = self.build_forward()
+
+        def total_loss(stacked, xs, ys):
+            out = apply(stacked, xs)
+            M = xs.shape[0]
+            losses = jax.vmap(loss_fn)(out, ys)
+            return jnp.mean(losses)
+
+        if self._train_step is None:
+            self._train_step = jax.jit(jax.value_and_grad(total_loss))
+        return self._train_step(self.stacked, microbatches, labels_mb)
+
+    def apply_grads_sgd(self, grads, lr: float):
+        self.stacked = [p - lr * g for p, g in zip(self.stacked, grads)]

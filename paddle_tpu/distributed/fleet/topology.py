@@ -1,0 +1,208 @@
+"""Hybrid-parallel topology.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/base/topology.py``
+(``CommunicateTopology``:36, ``HybridCommunicateGroup``:117 — the rank mesh
+``dp x pp x sharding x mp`` and its sub-groups).
+
+TPU-first: the topology directly BUILDS the 4-axis jax Mesh; each "comm
+group" is a mesh axis (collectives over it are XLA collectives on ICI), so
+there are no ring ids to initialize and no p2p groups to pre-create — the
+pipeline engine uses ppermute over the 'pp' axis.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import collective
+from .. import env as dist_env
+from .. import mesh as mesh_mod
+
+
+class CommunicateTopology:
+    """Parity: topology.py:36 — pure rank arithmetic over the hybrid axes."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in dims)))
+        self._word_size = int(np.prod(dims))
+        self._rank2coord = {self._coord_to_rank(c): c for c in self.coordinate}
+
+    def _coord_to_rank(self, coord) -> int:
+        rank = 0
+        for c, d in zip(coord, self._dims):
+            rank = rank * d + c
+        return rank
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._word_size
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord_to_rank(coord)
+
+    def get_coord(self, rank: int):
+        coord = self._rank2coord[rank]
+        import collections
+
+        C = collections.namedtuple("Coord", self._parallel_names)
+        return C(*coord)
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(
+            self._coord_to_rank(c) for c in self.coordinate if c[axis] == index
+        )
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along axis_name (ranks varying only in that axis)."""
+        axis = self._parallel_names.index(axis_name)
+        others = [
+            (i, d) for i, d in enumerate(self._dims) if i != axis
+        ]
+        groups = []
+        for combo in itertools.product(*(range(d) for _, d in others)):
+            group = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for (i, _), cv in zip(others, combo):
+                    coord[i] = cv
+                coord[axis] = v
+                group.append(self._coord_to_rank(tuple(coord)))
+            groups.append(group)
+        return groups
+
+
+# paddle axis name -> mesh axis name
+_AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """Parity: topology.py:117 — builds the jax hybrid Mesh and exposes the
+    per-axis (rank, world, group) accessors the meta_parallel engines use."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = dist_env.get_rank()
+        self.nranks = topology.world_size()
+
+        names = topology.get_hybrid_group_names()
+        dims = {n: topology.get_dim(n) for n in names}
+        self._dp_degree = dims.get("data", 1)
+        self._pp_degree = dims.get("pipe", 1)
+        self._sharding_degree = dims.get("sharding", 1)
+        self._mp_degree = dims.get("model", 1)
+
+        # install the hybrid mesh over the actual jax devices
+        self.mesh = mesh_mod.build_hybrid_mesh(
+            dp=self._dp_degree, mp=self._mp_degree, pp=self._pp_degree,
+            sharding=self._sharding_degree,
+        )
+
+        coord = topology.get_coord(self.global_rank) if self.nranks > 1 else None
+        self._dp_rank = getattr(coord, "data", 0) if coord else 0
+        self._pp_rank = getattr(coord, "pipe", 0) if coord else 0
+        self._sharding_rank = getattr(coord, "sharding", 0) if coord else 0
+        self._mp_rank = getattr(coord, "model", 0) if coord else 0
+
+        # groups bound to mesh axes (ring_id -> axis for the kernels)
+        self._dp_group = collective.new_group(axis_name="dp")
+        self._pp_group = collective.new_group(axis_name="pp")
+        self._sharding_group = collective.new_group(axis_name="sharding")
+        self._mp_group = collective.new_group(axis_name="mp")
+        self._check_group = collective.new_group(axis_name=None)
+
+    # -- parity accessors -------------------------------------------------
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1:
+            return "data_parallel"
+        if self._sharding_degree > 1 and self._mp_degree == 1 and self._pp_degree == 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1 and self._pp_degree == 1:
+            return "tensor_parallel"
+        if self._pp_degree > 1:
+            return "pipeline_parallel"
+        return "data_parallel"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    def get_stage_id(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self._pp_rank == 0
+
+    def is_last_stage(self):
+        return self._pp_rank == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    def get_check_parallel_group(self):
+        return self._check_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(
+            data=self._dp_rank, pipe=stage_id,
+            sharding=self._sharding_rank, model=self._mp_rank,
+        )

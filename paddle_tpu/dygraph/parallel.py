@@ -1,0 +1,25 @@
+"""``paddle.DataParallel``.
+
+Parity: ``/root/reference/python/paddle/fluid/dygraph/parallel.py:382``
+(DataParallel wrapping the C++ Reducer — bucketed overlapped allreduce,
+``reducer.cc`` 1,091 LoC).
+
+TPU-first: the Reducer is unnecessary (SURVEY.md §7 layer 6) — inputs are
+sharded over the 'dp' mesh axis and parameters replicated, so the gradient
+of a replicated param over a sharded batch IS the allreduced gradient; XLA
+emits and overlaps the reduction.  scale_loss / apply_collective_grads are
+kept as no-op parity shims.
+"""
+
+from __future__ import annotations
+
+from ..distributed.fleet.meta_parallel.parallel_wrappers import DataParallelSPMD
+from ..distributed import mesh as mesh_mod
+
+
+class DataParallel(DataParallelSPMD):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        mesh_mod.ensure_default_mesh()
+        super().__init__(layers, hcg=None, strategy=None)

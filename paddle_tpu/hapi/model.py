@@ -59,6 +59,14 @@ class Model:
             labels = [labels]
         return self._loss(*outputs, *labels)
 
+    @staticmethod
+    def _update_metric(m, outputs, labels):
+        label = labels[0] if isinstance(labels, (list, tuple)) else labels
+        res = m.compute(outputs, label)
+        if not isinstance(res, tuple):
+            res = (res,)
+        m.update(*res)
+
     def train_batch(self, inputs, labels=None):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -67,9 +75,8 @@ class Model:
         loss.backward()
         self._optimizer.step()
         self._optimizer.clear_grad()
-        metrics = []
         for m in self._metrics:
-            m.update(m.compute(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels))
+            self._update_metric(m, outputs, labels)
         return loss
 
     def eval_batch(self, inputs, labels=None):
@@ -81,7 +88,7 @@ class Model:
             outputs = self.network(*inputs)
             loss = self._compute_loss(outputs, labels)
         for m in self._metrics:
-            m.update(m.compute(outputs, labels[0] if isinstance(labels, (list, tuple)) else labels))
+            self._update_metric(m, outputs, labels)
         return loss
 
     def predict_batch(self, inputs):
@@ -133,6 +140,7 @@ class Model:
 
         cbk.on_train_begin()
         it = 0
+        logs = {}
         for epoch in range(epochs):
             cbk.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -152,10 +160,10 @@ class Model:
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
-            cbk.on_epoch_end(epoch, logs if steps else None)
+            cbk.on_epoch_end(epoch, logs or None)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size, verbose=verbose,
-                              num_workers=num_workers, callbacks=None)
+                              num_workers=num_workers, _cbk=cbk)
             if any(getattr(c, "stop_training", False) for c in cbks):
                 break
             if num_iters is not None and it >= num_iters:
@@ -163,20 +171,31 @@ class Model:
         cbk.on_train_end()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None, num_samples=None):
+                 num_workers=0, callbacks=None, num_samples=None, _cbk=None):
         loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        if _cbk is None and callbacks:
+            _cbk = CallbackList(list(callbacks))
+            _cbk.set_model(self)
+        if _cbk is not None:
+            _cbk.on_eval_begin()
         for m in self._metrics:
             m.reset()
         total_loss, n = 0.0, 0
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            if _cbk is not None:
+                _cbk.on_eval_batch_begin(step)
             ins, label = self._split_batch(batch)
             loss = self.eval_batch(ins, label)
             total_loss += float(loss.numpy())
             n += 1
+            if _cbk is not None:
+                _cbk.on_eval_batch_end(step, {"loss": float(loss.numpy())})
         logs = {"loss": total_loss / max(n, 1)}
         for m in self._metrics:
             name = m.name()
             logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+        if _cbk is not None:
+            _cbk.on_eval_end(logs)
         if verbose:
             print("Eval - " + " - ".join(f"{k}: {v}" for k, v in logs.items()))
         return logs

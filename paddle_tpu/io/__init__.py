@@ -293,15 +293,14 @@ def _to_device(batch, return_list=True):
     return conv(batch)
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, use_shared_memory):
-    """Parity: fluid/dataloader/worker.py _worker_loop (fork + queue IPC)."""
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 num_workers, worker_init_fn):
+    """Parity: fluid/dataloader/worker.py _worker_loop (spawn + queue IPC).
+    Large-batch shared-memory transport lands with the C ring buffer (csrc/);
+    until then batches ship pickled through the queue."""
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
-    try:
-        from ..utils import shm_channel
-
-        shm = shm_channel.Writer() if use_shared_memory and shm_channel.available() else None
-    except Exception:
-        shm = None
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
     while True:
         item = index_queue.get()
         if item is None:
@@ -309,10 +308,7 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_wo
         seq, indices = item
         try:
             batch = collate_fn([dataset[i] for i in indices])
-            if shm is not None:
-                data_queue.put((seq, shm.put(batch)))
-            else:
-                data_queue.put((seq, batch))
+            data_queue.put((seq, batch))
         except Exception as e:  # ship the error to the main process
             import traceback
 
@@ -336,6 +332,9 @@ class DataLoader:
         self.timeout = timeout
         self.prefetch_factor = prefetch_factor
         self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None  # (index_queues, data_queue, workers) when persistent
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_size = batch_size
@@ -379,7 +378,7 @@ class DataLoader:
             yield _to_device(self.collate_fn(buf), self.return_list)
 
     # -- multi process (dataloader_iter.py:248 parity) --------------------
-    def _iter_multiprocess(self):
+    def _spawn_pool(self):
         import multiprocessing as mp
 
         # spawn, not fork: the parent holds an initialized (multithreaded)
@@ -392,11 +391,37 @@ class DataLoader:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[wid], data_queue,
-                      self.collate_fn, wid, self.num_workers, self.use_shared_memory),
+                      self.collate_fn, wid, self.num_workers, self.worker_init_fn),
                 daemon=True,
             )
             w.start()
             workers.append(w)
+        return index_queues, data_queue, workers
+
+    def _shutdown_pool(self, pool):
+        index_queues, _, workers = pool
+        for q in index_queues:
+            q.put(None)
+        for w in workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        if self._pool is not None:
+            try:
+                self._shutdown_pool(self._pool)
+            except Exception:
+                pass
+            self._pool = None
+
+    def _iter_multiprocess(self):
+        if self.persistent_workers:
+            if self._pool is None:
+                self._pool = self._spawn_pool()
+            index_queues, data_queue, workers = self._pool
+        else:
+            index_queues, data_queue, workers = self._spawn_pool()
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
@@ -422,9 +447,5 @@ class DataLoader:
                     yield _to_device(reorder.pop(next_yield), self.return_list)
                     next_yield += 1
         finally:
-            for q in index_queues:
-                q.put(None)
-            for w in workers:
-                w.join(timeout=1)
-                if w.is_alive():
-                    w.terminate()
+            if not self.persistent_workers:
+                self._shutdown_pool((index_queues, data_queue, workers))

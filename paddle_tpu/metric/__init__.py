@@ -132,11 +132,9 @@ class Auc(Metric):
         if preds.ndim == 2:
             preds = preds[:, 1] if preds.shape[1] > 1 else preds[:, 0]
         idx = np.clip((preds * self.num_thresholds).astype("int64"), 0, self.num_thresholds)
-        for i, l in zip(idx, labels):
-            if l:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        pos = labels.astype(bool)
+        np.add.at(self._stat_pos, idx[pos], 1)
+        np.add.at(self._stat_neg, idx[~pos], 1)
 
     def accumulate(self):
         tot_pos = tot_neg = auc = 0.0

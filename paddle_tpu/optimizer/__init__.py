@@ -46,10 +46,12 @@ class Optimizer:
         else:
             self.regularization = weight_decay
         if isinstance(learning_rate, LRScheduler):
+            import weakref
+
             bound = getattr(learning_rate, "_bound_optimizers", None)
             if bound is None:
-                bound = learning_rate._bound_optimizers = []
-            bound.append(self)
+                bound = learning_rate._bound_optimizers = weakref.WeakSet()
+            bound.add(self)
         self._grad_clip = grad_clip
         # accumulators: acc_name -> param_name -> Tensor (dygraph) / Variable (static)
         self._accumulators: Dict[str, Dict[str, object]] = {}

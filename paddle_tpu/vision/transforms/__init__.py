@@ -225,6 +225,14 @@ class Transpose(BaseTransform):
         return _as_hwc(img).transpose(self.order)
 
 
+def _restore_dtype(out: np.ndarray, like) -> np.ndarray:
+    """uint8 images stay clipped uint8; float images keep their dtype/range."""
+    src = np.asarray(like)
+    if src.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype("uint8")
+    return out.astype(src.dtype)
+
+
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         self.value = value
@@ -233,7 +241,7 @@ class BrightnessTransform(BaseTransform):
         if self.value == 0:
             return img
         f = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return np.clip(_as_hwc(img).astype("float32") * f, 0, 255).astype(np.asarray(img).dtype)
+        return _restore_dtype(_as_hwc(img).astype("float32") * f, img)
 
 
 class ContrastTransform(BaseTransform):
@@ -243,10 +251,10 @@ class ContrastTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return img
-        img = _as_hwc(img).astype("float32")
+        out = _as_hwc(img).astype("float32")
         f = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        mean = img.mean()
-        return np.clip((img - mean) * f + mean, 0, 255).astype("uint8")
+        mean = out.mean()
+        return _restore_dtype((out - mean) * f + mean, img)
 
 
 class SaturationTransform(BaseTransform):
@@ -256,10 +264,10 @@ class SaturationTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return img
-        img = _as_hwc(img).astype("float32")
-        gray = img.mean(axis=2, keepdims=True)
+        out = _as_hwc(img).astype("float32")
+        gray = out.mean(axis=2, keepdims=True)
         f = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return np.clip(gray + (img - gray) * f, 0, 255).astype("uint8")
+        return _restore_dtype(gray + (out - gray) * f, img)
 
 
 class HueTransform(BaseTransform):
@@ -311,10 +319,10 @@ class Grayscale(BaseTransform):
         self.num_output_channels = num_output_channels
 
     def _apply_image(self, img):
-        img = _as_hwc(img).astype("float32")
-        gray = (img * np.array([0.299, 0.587, 0.114])[: img.shape[2]]).sum(
+        out = _as_hwc(img).astype("float32")
+        gray = (out * np.array([0.299, 0.587, 0.114])[: out.shape[2]]).sum(
             axis=2, keepdims=True
         )
         if self.num_output_channels == 3:
             gray = np.repeat(gray, 3, axis=2)
-        return gray.astype("uint8")
+        return _restore_dtype(gray, img)
