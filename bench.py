@@ -1,0 +1,104 @@
+"""Benchmark: GPT pretraining step throughput + MFU on the available device.
+
+Prints ONE JSON line:
+  {"metric": "gpt_tokens_per_sec_per_chip", "value": N, "unit": "tokens/s",
+   "vs_baseline": MFU/0.45}
+
+vs_baseline is measured MFU against the BASELINE.json north-star target of
+45% MFU (the reference publishes no numbers of its own — BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _flops_per_token(cfg) -> float:
+    """6*N (fwd+bwd) with attention term; N = non-embedding params approx."""
+    h, L, s, v = cfg.hidden_size, cfg.num_layers, cfg.max_seq_len, cfg.vocab_size
+    n_block = L * (12 * h * h)  # qkv+proj+mlp params per block
+    flops = 6.0 * n_block
+    flops += 12.0 * L * h * s  # attention matmuls (per token, seq-dependent)
+    flops += 6.0 * v * h  # lm head
+    return flops
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+    from paddle_tpu.models.gpt import build_functional_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu" or "TPU" in str(dev.device_kind)
+
+    # size the model to the platform: real GPT-small-ish on TPU, tiny on CPU
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=12,
+                        num_heads=16, max_seq_len=1024, dropout=0.0)
+        # batch 16 without remat is the measured sweet spot on one v5e chip
+        # (b16 remat: 45k tok/s, b16 no-remat: 59k, b24+: compile OOM)
+        batch, seq, steps = 16, 1024, 10
+        # v5e: 197 TFLOP/s bf16 per chip
+        peak_flops = 197e12
+        dtype = "bfloat16"
+    else:
+        cfg = GPTConfig(vocab_size=2048, hidden_size=256, num_layers=4,
+                        num_heads=8, max_seq_len=256, dropout=0.0)
+        batch, seq, steps = 4, 256, 3
+        peak_flops = 1e12  # nominal; CPU MFU is not meaningful
+        dtype = "float32"
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    if dtype == "bfloat16":
+        # bf16 params on TPU: MXU-native (master-weight AdamW state stays fp32)
+        import jax.numpy as jnp
+
+        for p in model.parameters():
+            p._array = p._array.astype(jnp.bfloat16)
+
+    step, params, opt_state = build_functional_train_step(
+        model, lr=1e-4, remat=not on_tpu)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64")
+
+    # compile + warmup
+    params, opt_state, loss = step(params, opt_state, ids, labels)
+    np.asarray(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    np.asarray(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tps = tokens / dt
+    flops_tok = _flops_per_token(cfg)
+    mfu = tps * flops_tok / peak_flops
+
+    print(json.dumps({
+        "metric": "gpt_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "loss": float(np.asarray(loss)),
+            "platform": dev.platform,
+            "device": str(getattr(dev, "device_kind", dev)),
+            "config": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
+                        "seq": seq, "batch": batch, "dtype": dtype},
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

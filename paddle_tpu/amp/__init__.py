@@ -1,0 +1,14 @@
+"""``paddle.amp`` — automatic mixed precision.
+
+Parity: ``/root/reference/python/paddle/amp/`` (auto_cast.py, grad_scaler.py)
++ the tracer-level cast logic ``imperative/amp_auto_cast.{h,cc}``
+(AmpOperators white/black lists, AutoCastInputs:171).
+
+TPU-first: level O1 casts matmul/conv-family inputs to **bfloat16** (the MXU
+native type) instead of float16; bf16 keeps fp32's exponent range so dynamic
+loss scaling is unnecessary — GradScaler degrades to an API-complete
+passthrough unless dtype='float16' is forced.
+"""
+
+from .auto_cast import auto_cast, amp_guard, white_list, black_list, decorate  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

@@ -1,0 +1,123 @@
+"""auto_cast context — tracer-level per-op dtype policy.
+
+Parity: ``imperative/amp_auto_cast.cc`` (AutoCastInputs:171 — white list ops
+cast inputs to low precision, black list to fp32, gray follow inputs) and
+``python/paddle/amp/auto_cast.py`` / ``fluid/dygraph/amp/auto_cast.py:151``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Set
+
+import jax.numpy as jnp
+
+# parity: fluid/contrib/mixed_precision/fp16_lists.py white/black lists
+white_list: Set[str] = {
+    "conv2d", "depthwise_conv2d", "conv2d_transpose", "matmul", "matmul_v2",
+    "mul", "scaled_dot_product_attention",
+}
+black_list: Set[str] = {
+    "exp", "square", "log", "mean", "sum", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "c_softmax_with_cross_entropy",
+    "cross_entropy", "layer_norm", "batch_norm", "reduce_mean", "reduce_sum",
+    "softmax", "log_softmax", "p_norm", "squared_l2_norm",
+}
+
+
+class AmpState:
+    def __init__(self, enable: bool, dtype: str, level: str,
+                 custom_white: Optional[List[str]] = None,
+                 custom_black: Optional[List[str]] = None):
+        self.enable = enable
+        self.dtype = dtype  # 'bfloat16' (TPU default) or 'float16'
+        self.level = level.upper()  # 'O1' | 'O2'
+        custom_white = set(custom_white or ())
+        custom_black = set(custom_black or ())
+        # custom black wins over the default white list (fp16_lists parity)
+        self.white = (set(white_list) | custom_white) - custom_black
+        self.black = (set(black_list) | custom_black) - custom_white
+
+
+def _cast_tensor(t, dtype):
+    from ..dygraph.tensor import Tensor
+
+    if not jnp.issubdtype(t._array.dtype, jnp.floating):
+        return t
+    if str(t._array.dtype) == dtype:
+        return t
+    if t.stop_gradient and t.grad_node is None:
+        return Tensor(t._array.astype(dtype), stop_gradient=True)
+    # differentiable tensor: cast THROUGH the tape so the grad path routes
+    # back to the original tensor (cast is amp-gray, so no recursion)
+    from ..dygraph import tracer
+
+    return tracer.trace_op("cast", {"X": [t]}, {"out_dtype": dtype})["Out"][0]
+
+
+def maybe_autocast_inputs(amp: AmpState, op_type: str,
+                          ins: Dict[str, list], attrs: Dict):
+    """Called by the tracer for every op while amp is active
+    (AutoCastInputs parity)."""
+    if not amp.enable:
+        return ins, attrs
+    low = amp.dtype
+    if amp.level == "O2":
+        # pure low-precision except black list
+        target = "float32" if op_type in amp.black else low
+        return (
+            {s: [_cast_tensor(t, target) for t in ts] for s, ts in ins.items()},
+            attrs,
+        )
+    if op_type in amp.white:
+        return (
+            {s: [_cast_tensor(t, low) for t in ts] for s, ts in ins.items()},
+            attrs,
+        )
+    if op_type in amp.black:
+        return (
+            {s: [_cast_tensor(t, "float32") for t in ts] for s, ts in ins.items()},
+            attrs,
+        )
+    return ins, attrs
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """Parity: paddle.amp.auto_cast (bf16 default on TPU)."""
+    from ..dygraph import tracer
+
+    old = tracer.amp_state()
+    tracer.set_amp_state(
+        AmpState(enable, dtype, level, custom_white_list, custom_black_list)
+        if enable else None
+    )
+    try:
+        yield
+    finally:
+        tracer.set_amp_state(old)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Parity: paddle.amp.decorate — O2 casts model params to low precision
+    (optimizer keeps fp32 master state via its fp32 accumulators)."""
+    import jax.numpy as jnp_
+
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level.upper() == "O2":
+        target = jnp_.bfloat16 if dtype == "bfloat16" else jnp_.float16
+        for m in model_list:
+            if m is None:
+                continue
+            for p in m.parameters():
+                if jnp_.issubdtype(p._array.dtype, jnp_.floating):
+                    p._array = p._array.astype(target)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

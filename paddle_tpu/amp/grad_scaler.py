@@ -1,0 +1,117 @@
+"""GradScaler — dynamic loss scaling.
+
+Parity: ``/root/reference/python/paddle/amp/grad_scaler.py`` +
+``fluid/dygraph/amp/loss_scaler.py`` and the kernels
+``check_finite_and_unscale`` / ``update_loss_scaling``
+(operators/amp/*.cu parity in ops/optimizer_ops.py).
+
+On TPU the default AMP dtype is bfloat16, whose range matches fp32 — scaling
+is then a mathematical no-op but the API (scale/step/update/minimize) remains
+fully functional, and with dtype='float16' the full dynamic-scale state
+machine runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph.tensor import Tensor
+from ..dygraph import tracer
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from .. import tensor_api as T
+
+        return T.scale(loss, self._scale)
+
+    def unscale_(self, optimizer):
+        """Idempotent per step (parity: the reference tracks OptimizerState so
+        the unscale_ -> clip -> step() recipe does not divide twice)."""
+        if not self._enable or self._already_unscaled:
+            return
+        import jax.numpy as jnp
+
+        inv = 1.0 / self._scale
+        finite = jnp.asarray(True)
+        for p in optimizer._parameter_list or []:
+            if p.grad is None:
+                continue
+            g = p.grad._array.astype(jnp.float32) * inv
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+            p.grad._array = g.astype(p.grad._array.dtype)
+        # ONE host sync for the whole gradient set (check_finite_and_unscale
+        # kernel parity)
+        self._found_inf = not bool(finite)
+        self._already_unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        self._already_unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def state_dict(self):
+        return {
+            "scale": self._scale, "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio, "incr_count": self._good,
+            "decr_count": self._bad, "use_dynamic_loss_scaling": self._dynamic,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good = state.get("incr_count", 0)
+        self._bad = state.get("decr_count", 0)
+
+
+AmpScaler = GradScaler

@@ -20,13 +20,7 @@ from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
 from .progressbar import ProgressBar
 
 
-class InputSpec:
-    """Parity: paddle.static.InputSpec (declares model inputs for save)."""
-
-    def __init__(self, shape, dtype="float32", name=None):
-        self.shape = tuple(shape)
-        self.dtype = dtype
-        self.name = name
+from ..static.input import InputSpec  # noqa: F401  (single definition)
 
 
 class Model:

@@ -1,0 +1,13 @@
+"""Flagship model zoo (NLP): GPT / BERT pretraining models.
+
+Role parity: the reference's headline workloads are PaddleNLP ERNIE/GPT
+pretraining (BASELINE.json configs 2-3); PaddleNLP is a separate repo, so
+this package provides the equivalent in-framework model family, built
+TPU-first (fused SDPA, TP/PP-ready blocks, one-jit train step).
+"""
+
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForPretraining, GPTModel, GPTPretrainingCriterion,
+    build_functional_train_step, gpt_tiny, gpt_small, gpt_medium, gpt_1p3b, gpt_13b,
+)
+from .bert import BertConfig, BertModel, BertForPretraining  # noqa: F401

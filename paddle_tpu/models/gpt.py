@@ -1,0 +1,305 @@
+"""GPT model family — the flagship pretraining workload.
+
+Role parity: PaddleNLP GPT-2/3 (`gpt` modeling built on the reference's
+``paddle.nn.TransformerDecoder`` + fleet hybrid parallel; BASELINE.json
+config 3: "GPT-3 1.3B/13B with Fleet hybrid sharding + pipeline parallel").
+
+TPU-first:
+  * attention = fused ``scaled_dot_product_attention`` (flash/Pallas on TPU);
+  * TP via Column/RowParallelLinear + VocabParallelEmbedding when an 'mp'
+    mesh axis is active (GSPMD shardings, XLA collectives on ICI);
+  * :func:`build_functional_train_step` compiles ONE XLA program for
+    fwd+bwd+AdamW over the hybrid mesh — the path bench.py and
+    ``__graft_entry__.dryrun_multichip`` exercise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from .. import tensor_api as T
+from ..distributed import mesh as mesh_mod
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: Optional[int] = None
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_parallel: bool = False  # TP layers over the 'mp' axis
+
+    def __post_init__(self):
+        if self.ffn_hidden is None:
+            self.ffn_hidden = 4 * self.hidden_size
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4, num_heads=4,
+                     max_seq_len=256, **kw)
+
+
+def gpt_small(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_medium(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+def gpt_13b(**kw):
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_seq_len=2048, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.dropout = cfg.dropout
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        wa = nn.ParamAttr(initializer=init)
+        if cfg.use_parallel:
+            from ..distributed.fleet import meta_parallel as mpp
+
+            self.qkv = mpp.ColumnParallelLinear(
+                cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=wa,
+                gather_output=False)
+            self.proj = mpp.RowParallelLinear(
+                cfg.hidden_size, cfg.hidden_size, weight_attr=wa,
+                input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=wa)
+            self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size, weight_attr=wa)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x)
+        local_h = qkv.shape[-1] // 3
+        nh = local_h // self.head_dim
+        qkv = T.reshape(qkv, [b, s, 3, nh, self.head_dim])
+        qkv = T.transpose(qkv, [2, 0, 3, 1, 4])  # [3, b, nh, s, hd]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout, training=self.training)
+        out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, local_h])
+        return self.proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        wa = nn.ParamAttr(initializer=init)
+        if cfg.use_parallel:
+            from ..distributed.fleet import meta_parallel as mpp
+
+            self.fc1 = mpp.ColumnParallelLinear(
+                cfg.hidden_size, cfg.ffn_hidden, weight_attr=wa, gather_output=False)
+            self.fc2 = mpp.RowParallelLinear(
+                cfg.ffn_hidden, cfg.hidden_size, weight_attr=wa, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(cfg.hidden_size, cfg.ffn_hidden, weight_attr=wa)
+            self.fc2 = nn.Linear(cfg.ffn_hidden, cfg.hidden_size, weight_attr=wa)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN decoder block — homogeneous, so the SPMD pipeline engine can
+    stack it over the 'pp' axis."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        if cfg.use_parallel:
+            from ..distributed.fleet import meta_parallel as mpp
+
+            self.word_embeddings = mpp.VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        else:
+            self.word_embeddings = nn.Embedding(
+                cfg.vocab_size, cfg.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_seq_len, cfg.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, ids):
+        b, s = ids.shape
+        pos = T.arange(0, s, 1, dtype="int64")
+        x = self.word_embeddings(ids) + self.position_embeddings(pos)
+        return self.dropout(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, ids):
+        x = self.embeddings(ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to the word embedding (PaddleNLP GPTForPretraining parity)."""
+
+    def __init__(self, model_or_cfg):
+        super().__init__()
+        self.gpt = model_or_cfg if isinstance(model_or_cfg, GPTModel) else GPTModel(model_or_cfg)
+        self.cfg = self.gpt.cfg
+
+    def forward(self, ids):
+        x = self.gpt(ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return T.matmul(x, w, transpose_y=True)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Next-token CE (vocab-parallel when logits are mp-sharded)."""
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = F.softmax_with_cross_entropy(logits, T.unsqueeze(labels, [-1]))
+        loss = T.squeeze(loss, [-1])
+        if loss_mask is not None:
+            return T.divide(T.sum(T.multiply(loss, loss_mask)),
+                            T.maximum(T.sum(loss_mask), T.full_like(T.sum(loss_mask), 1.0)))
+        return T.mean(loss)
+
+
+# ---------------------------------------------------------------------------
+# One-jit functional train step (the bench / multichip path)
+# ---------------------------------------------------------------------------
+
+
+def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
+                                beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
+                                dp_axis="dp", remat: bool = True):
+    """Compile fwd+bwd+AdamW into ONE donated XLA program.
+
+    Returns (step_fn, params, opt_state):
+      step_fn(params, opt_state, ids, labels) -> (params, opt_state, loss)
+    with ids/labels expected dp-sharded on the batch dim and params carrying
+    whatever mesh shardings the layers installed (mp/pp/replicated).
+    ``remat=True`` wraps each block in jax.checkpoint — trading FLOPs for HBM
+    (the reference's RecomputeOptimizer role, fluid/optimizer.py:5407).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..dygraph import tracer
+    from ..dygraph.tensor import Tensor
+
+    param_objs = list(model.parameters())
+    params = [p._array for p in param_objs]
+
+    blocks = list(model.gpt.blocks)
+
+    def fwd(param_arrays, ids):
+        old = [p._array for p in param_objs]
+        for p, a in zip(param_objs, param_arrays):
+            p._array = a
+        og = tracer.set_grad_enabled(False)
+        try:
+            x = model.gpt.embeddings(Tensor(ids, stop_gradient=True))._array
+
+            def block_fn(blk, h):
+                return blk(Tensor(h, stop_gradient=True))._array
+
+            for blk in blocks:
+                f = (jax.checkpoint(lambda h, b=blk: block_fn(b, h))
+                     if remat else (lambda h, b=blk: block_fn(b, h)))
+                x = f(x)
+            x = model.gpt.ln_f(Tensor(x, stop_gradient=True))._array
+            w = model.gpt.embeddings.word_embeddings.weight._array
+            return jnp.matmul(x, w.T)
+        finally:
+            tracer.set_grad_enabled(og)
+            for p, a in zip(param_objs, old):
+                p._array = a
+
+    def loss_fn(param_arrays, ids, labels):
+        logits = fwd(param_arrays, ids)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[..., None], axis=-1
+        )[..., 0]
+        return jnp.mean(lse - picked)
+
+    # AdamW state — moments AND master weights in fp32 even when compute
+    # params are bf16 (mixed-precision parity: the reference's
+    # multi_precision adam keeps FP32 master params; bf16-only updates round
+    # sub-ulp deltas to zero and stall training)
+    low_precision = any(p.dtype != jnp.float32 for p in params)
+    opt_state = {
+        "m": [jnp.zeros(p.shape, jnp.float32) for p in params],
+        "v": [jnp.zeros(p.shape, jnp.float32) for p in params],
+        "t": jnp.zeros((), jnp.int32),
+    }
+    if low_precision:
+        opt_state["master"] = [p.astype(jnp.float32) for p in params]
+
+    def step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        t = opt_state["t"] + 1
+        b1t = 1.0 - beta1 ** t.astype(jnp.float32)
+        b2t = 1.0 - beta2 ** t.astype(jnp.float32)
+        masters = opt_state.get("master", params)
+        new_p, new_m, new_v, new_master = [], [], [], []
+        for p, w32, g, m, v in zip(params, masters, grads, opt_state["m"], opt_state["v"]):
+            gf = g.astype(jnp.float32)
+            m2 = beta1 * m + (1 - beta1) * gf
+            v2 = beta2 * v + (1 - beta2) * jnp.square(gf)
+            upd = (m2 / b1t) / (jnp.sqrt(v2 / b2t) + eps) + wd * w32.astype(jnp.float32)
+            w_new = w32.astype(jnp.float32) - lr * upd
+            new_master.append(w_new)
+            new_p.append(w_new.astype(p.dtype))
+            new_m.append(m2)
+            new_v.append(v2)
+        new_state = {"m": new_m, "v": new_v, "t": t}
+        if "master" in opt_state:
+            new_state["master"] = new_master
+        return new_p, new_state, loss
+
+    step_jit = jax.jit(step, donate_argnums=(0, 1))
+    return step_jit, params, opt_state

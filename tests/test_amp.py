@@ -1,0 +1,87 @@
+"""AMP tests (parity role: reference test_imperative_auto_mixed_precision)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp
+
+
+def test_auto_cast_o1_matmul_bf16():
+    x = paddle.randn([4, 8])
+    w = paddle.randn([8, 8])
+    with amp.auto_cast():
+        y = paddle.matmul(x, w)  # white-list op computes in bf16
+    assert y.dtype == "bfloat16"
+    # black-list op stays fp32
+    with amp.auto_cast():
+        m = paddle.mean(x)
+    assert m.dtype == "float32"
+
+
+def test_auto_cast_grads_flow_to_fp32_params():
+    paddle.seed(0)
+    l = nn.Linear(8, 4)
+    o = opt.SGD(0.1, parameters=l.parameters())
+    x = paddle.randn([2, 8])
+    with amp.auto_cast():
+        loss = l(x).mean()
+    loss.backward()
+    g = l.weight.grad
+    assert g is not None
+    assert l.weight.dtype == "float32"
+    o.step()
+
+
+def test_grad_scaler_fp16_dynamic():
+    scaler = amp.GradScaler(init_loss_scaling=4.0, incr_every_n_steps=2,
+                            decr_every_n_nan_or_inf=1)
+    l = nn.Linear(4, 1)
+    o = opt.SGD(0.1, parameters=l.parameters())
+    x = paddle.ones([2, 4])
+    loss = l(x).mean()
+    scaled = scaler.scale(loss)
+    np.testing.assert_allclose(scaled.numpy(), loss.numpy() * 4.0, rtol=1e-6)
+    scaled.backward()
+    w0 = l.weight.numpy().copy()
+    scaler.step(o)
+    o.clear_grad()
+    # grads were unscaled before the update: equal to unscaled grad * lr
+    assert not np.allclose(w0, l.weight.numpy())
+    # inf grads skip the step and shrink the scale
+    loss = l(x).mean()
+    scaler.scale(loss).backward()
+    l.weight.grad._array = l.weight.grad._array * np.inf
+    w1 = l.weight.numpy().copy()
+    scaler.step(o)
+    np.testing.assert_allclose(w1, l.weight.numpy())
+    assert scaler.get_loss_scaling() == 2.0
+
+
+def test_amp_training_converges():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 1))
+    o = opt.Adam(0.01, parameters=net.parameters())
+    xb = rng.randn(32, 8).astype("float32")
+    w = rng.randn(8, 1).astype("float32")
+    losses = []
+    for _ in range(30):
+        x = paddle.to_tensor(xb)
+        y = paddle.to_tensor(xb @ w)
+        with amp.auto_cast():
+            loss = F.mse_loss(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_decorate_o2():
+    l = nn.Linear(4, 4)
+    l2 = amp.decorate(models=l, level="O2")
+    assert l2.weight.dtype == "bfloat16"

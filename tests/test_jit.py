@@ -1,0 +1,76 @@
+"""paddle.jit tests (parity role: reference dygraph_to_static tests —
+eager vs converted output parity)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit
+from paddle_tpu.hapi.model import InputSpec
+
+
+def test_to_static_function_parity():
+    @jit.to_static
+    def f(x, y):
+        return paddle.matmul(x, y) + 1.0
+
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    out = f(a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy() + 1.0, rtol=1e-5)
+
+
+def test_to_static_layer_parity():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([5, 4])
+    eager = net(x).numpy()
+    snet = jit.to_static(net)
+    static = snet(x).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+    net.eval()
+    x = paddle.randn([2, 6])
+    expected = net(x).numpy()
+    path = str(tmp_path / "saved" / "model")
+    jit.save(net, path, input_spec=[InputSpec([-1, 6], "float32")])
+    loaded = jit.load(path)
+    got = loaded(x).numpy()
+    np.testing.assert_allclose(expected, got, rtol=1e-5, atol=1e-6)
+
+
+def test_save_inference_model_static(tmp_path):
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import program as fw
+        from paddle_tpu.framework.scope import Scope
+        from paddle_tpu.static.executor import Executor
+        from paddle_tpu.static import io as sio
+
+        main, startup = fw.Program(), fw.Program()
+        with fw.program_guard(main, startup):
+            x = main.global_block().create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True)
+            l = nn.Linear(4, 2)
+            out = l(x)
+        from paddle_tpu.framework.scope import global_scope
+
+        exe = Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+        (expected,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        path = str(tmp_path / "inf" / "model")
+        sio.save_inference_model(path, [x], [out], program=main)
+        prog2, feeds, fetches = sio.load_inference_model(path, scope=Scope())
+        # reload into a fresh scope
+        s2 = Scope()
+        prog3, feeds3, fetches3 = sio.load_inference_model(path, scope=s2)
+        (got,) = exe.run(prog3, feed={feeds3[0]: xv}, fetch_list=fetches3, scope=s2)
+        np.testing.assert_allclose(expected, got, rtol=1e-6)
+    finally:
+        paddle.disable_static()
