@@ -1,0 +1,327 @@
+"""Pallas TPU flash attention — tiled online-softmax fwd + bwd.
+
+Role parity: the reference's fused attention CUDA kernel
+(``/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu:1`` and
+the 53-file ``operators/fused/`` zoo).  That kernel is inference-only; this
+one is a full fwd/bwd flash attention (Dao et al. 2022 recurrence) so
+activation memory is O(seq) instead of O(seq^2) — the main MFU lever for
+long-sequence GPT pretraining on TPU (BASELINE.md north star).
+
+Design (pallas_guide.md):
+  * grid = (batch*heads, seq blocks); K/V for one (b,h) live whole in VMEM,
+    the q-block loops over k-blocks with ``lax.fori_loop`` doing the online
+    softmax in fp32 on the MXU (``preferred_element_type``);
+  * causal masking skips fully-masked k-blocks (loop bound, not a mask);
+  * backward = two kernels (dQ; dK+dV) recomputing probabilities from the
+    saved logsumexp — no O(s^2) residuals;
+  * ``interpret=True`` runs the same kernels through the Pallas interpreter
+    so CPU tests cover the exact TPU code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def available() -> bool:
+    """True when the running backend can execute Mosaic/Pallas TPU kernels."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return dev.platform in ("tpu", "axon") or "TPU" in str(
+        getattr(dev, "device_kind", ""))
+
+
+def _pick_block(s: int, want: int = 128):
+    for b in (want, 256, 128, 64, 32, 16, 8):
+        if b <= s and s % b == 0:
+            return b
+    return None
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+    q = q_ref[...]
+    bq, d = q.shape
+    s_len = k_ref.shape[0]
+    i = pl.program_id(1)
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    nkb = s_len // block_k
+    if causal:
+        # q rows for this block end at (i+1)*bq - 1; k-blocks past that are
+        # fully masked — skip them entirely.
+        hi = jnp.minimum(((i + 1) * bq + block_k - 1) // block_k, nkb)
+    else:
+        hi = nkb
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kj = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    bh, s_len, d = q3.shape
+    nq = s_len // block_q
+    # Mosaic has no 64-bit types; trace the kernel with x64 promotion off so
+    # the framework-global jax_enable_x64 (int64 id parity) can't leak
+    # int64/f64 scalars into the lowering.
+    with jax.enable_x64(False):
+        out, lse = _fwd_call(q3, k3, v3, scale, causal, block_q, block_k,
+                             interpret, bh, s_len, d, nq)
+    return out, lse
+
+
+def _fwd_call(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+              bh, s_len, d, nq):
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s_len, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s_len), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_k):
+    q = q_ref[...]
+    do = do_ref[...].astype(jnp.float32)
+    bq, d = q.shape
+    s_len = k_ref.shape[0]
+    i = pl.program_id(1)
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
+
+    nkb = s_len // block_k
+    if causal:
+        hi = jnp.minimum(((i + 1) * bq + block_k - 1) // block_k, nkb)
+    else:
+        hi = nkb
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kj = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.astype(jnp.float32).T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q):
+    k = k_ref[...]
+    v = v_ref[...]
+    bk, d = k.shape
+    s_len = q_ref.shape[0]
+    j = pl.program_id(1)
+
+    nqb = s_len // block_q
+    lo = (j * bk) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            kj = j * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(qi >= kj, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T.astype(do.dtype), do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.astype(jnp.float32).T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jnp.dot(ds.T.astype(q.dtype), q,
+                          preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, nqb, body, (dk0, dv0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
+               interpret):
+    with jax.enable_x64(False):
+        return _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q,
+                         block_k, interpret)
+
+
+def _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
+              interpret):
+    bh, s_len, d = q3.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, s_len)
+
+    nq = s_len // block_q
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_len, d), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)
+
+    nk = s_len // block_k
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bh, nk),
+        in_specs=[
+            pl.BlockSpec((None, s_len, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, s_len, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s_len), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, 1, s_len), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, s_len, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(causal, scale, block_q, block_k, interpret, q3, k3, v3):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(causal, scale, block_q, block_k, interpret, q3, k3, v3):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    q3, k3, v3, out, lse = res
+    dq, dk, dv = _flash_bwd(q3, k3, v3, out, lse, do, scale, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
+                    block_q=None, block_k=None):
+    """Flash attention over [..., seq, head_dim] (self-attention: q/k same
+    length).  Falls back to None-return contract — callers should check
+    :func:`supported` first; unsupported shapes raise."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = not available()
+    s_len = q.shape[-2]
+    bq = block_q or _pick_block(s_len)
+    bk = block_k or _pick_block(s_len)
+    if bq is None or bk is None or k.shape[-2] != s_len:
+        raise ValueError(
+            f"flash_attention: unsupported seq len {s_len} (needs a power-of-"
+            f"two-ish divisor >= 8) or cross-attention q/k lengths")
+    lead = q.shape[:-2]
+    d = q.shape[-1]
+    q3 = q.reshape((-1, s_len, d))
+    k3 = k.reshape((-1, s_len, d))
+    v3 = v.reshape((-1, s_len, d))
+    out = _flash(causal, float(scale), int(bq), int(bk), bool(interpret),
+                 q3, k3, v3)
+    return out.reshape(lead + (s_len, d))
+
+
+def supported(q, k, mask=None, dropout_p=0.0) -> bool:
+    """Shape/feature gate used by the sdpa dispatcher."""
+    if mask is not None or dropout_p != 0.0:
+        return False
+    if q.ndim < 3 or q.shape[-2] != k.shape[-2]:
+        return False
+    return _pick_block(q.shape[-2]) is not None

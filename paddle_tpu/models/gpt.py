@@ -215,78 +215,166 @@ class GPTPretrainingCriterion(nn.Layer):
 def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                                 beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
                                 dp_axis="dp", remat: bool = True):
-    """Compile fwd+bwd+AdamW into ONE donated XLA program.
+    """Compile fwd+bwd+AdamW into ONE donated XLA program over the hybrid mesh.
 
     Returns (step_fn, params, opt_state):
       step_fn(params, opt_state, ids, labels) -> (params, opt_state, loss)
-    with ids/labels expected dp-sharded on the batch dim and params carrying
-    whatever mesh shardings the layers installed (mp/pp/replicated).
-    ``remat=True`` wraps each block in jax.checkpoint — trading FLOPs for HBM
-    (the reference's RecomputeOptimizer role, fluid/optimizer.py:5407).
+    ``params`` is ``(other_leaves, stacked_block_leaves)``: the homogeneous
+    decoder blocks are STACKED over the layer dim and the stack's leading dim
+    is sharded over the 'pp' mesh axis — each pp group holds only its own
+    stage's weights (pipeline memory scaling via GSPMD, the route
+    `fleet/meta_parallel/pipeline_parallel.py:114` reaches with send/recv).
+    The blocks run under ``lax.scan``, TP params keep their 'mp' specs, and
+    ids/labels are expected dp-sharded on the batch dim, so one jit covers
+    dp x mp x pp.  ``remat=True`` wraps each block in jax.checkpoint — the
+    reference's RecomputeOptimizer role (fluid/optimizer.py:5407).
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..dygraph import tracer
     from ..dygraph.tensor import Tensor
 
+    mesh = mesh_mod.get_mesh()
+    pp = mesh_mod.axis_size("pp")
+
     param_objs = list(model.parameters())
-    params = [p._array for p in param_objs]
-
     blocks = list(model.gpt.blocks)
+    block_param_objs = [list(b.parameters()) for b in blocks]
+    structs = [[(tuple(p.shape), str(p._array.dtype)) for p in ps]
+               for ps in block_param_objs]
+    homogeneous = len(blocks) > 1 and all(s == structs[0] for s in structs)
 
-    def fwd(param_arrays, ids):
-        old = [p._array for p in param_objs]
-        for p, a in zip(param_objs, param_arrays):
+    if homogeneous:
+        block_ids = {id(p) for ps in block_param_objs for p in ps}
+        other_objs = [p for p in param_objs if id(p) not in block_ids]
+    else:
+        other_objs = param_objs
+        block_param_objs = []
+
+    def _layer_spec(arr):
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            spec = list(sh.spec) + [None] * (arr.ndim - len(sh.spec))
+            return spec
+        return [None] * arr.ndim
+
+    def _mesh_put(arr):
+        """Ensure every leaf lives on the hybrid mesh (replicated unless a TP
+        layer already installed a NamedSharding)."""
+        if mesh is None:
+            return arr
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.devices.size == mesh.devices.size:
+            return arr
+        return jax.device_put(arr, NamedSharding(mesh, P()))
+
+    other = [_mesh_put(p._array) for p in other_objs]
+    stacked = []
+    if homogeneous:
+        for j in range(len(block_param_objs[0])):
+            leaves = [ps[j]._array for ps in block_param_objs]
+            st = jnp.stack(leaves)
+            if mesh is not None:
+                lead = "pp" if pp > 1 else None
+                st = jax.device_put(
+                    st, NamedSharding(mesh, P(lead, *_layer_spec(leaves[0]))))
+            stacked.append(st)
+
+    def _constrain_dp(x):
+        if mesh is not None and mesh_mod.axis_size(dp_axis) > 1:
+            return lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(dp_axis)))
+        return x
+
+    def fwd(params_tree, ids):
+        other_arrays, stacked_leaves = params_tree
+        old = [p._array for p in other_objs]
+        for p, a in zip(other_objs, other_arrays):
             p._array = a
         og = tracer.set_grad_enabled(False)
         try:
             x = model.gpt.embeddings(Tensor(ids, stop_gradient=True))._array
+            x = _constrain_dp(x)
 
-            def block_fn(blk, h):
-                return blk(Tensor(h, stop_gradient=True))._array
+            def block_fn(blk, objs, leaves, h):
+                saved = [p._array for p in objs]
+                for p, a in zip(objs, leaves):
+                    p._array = a
+                try:
+                    return blk(Tensor(h, stop_gradient=True))._array
+                finally:
+                    for p, a in zip(objs, saved):
+                        p._array = a
 
-            for blk in blocks:
-                f = (jax.checkpoint(lambda h, b=blk: block_fn(b, h))
-                     if remat else (lambda h, b=blk: block_fn(b, h)))
-                x = f(x)
+            if homogeneous:
+                tpl_objs = block_param_objs[0]
+
+                def one_block(h, leaves):
+                    return _constrain_dp(block_fn(blocks[0], tpl_objs, leaves, h))
+
+                body = jax.checkpoint(one_block) if remat else one_block
+
+                def scan_body(h, leaves):
+                    return body(h, leaves), None
+
+                x, _ = lax.scan(scan_body, x, tuple(stacked_leaves))
+            else:
+                for blk in blocks:
+                    f = (jax.checkpoint(lambda h, b=blk: block_fn(b, [], [], h))
+                         if remat else (lambda h, b=blk: block_fn(b, [], [], h)))
+                    x = f(x)
             x = model.gpt.ln_f(Tensor(x, stop_gradient=True))._array
             w = model.gpt.embeddings.word_embeddings.weight._array
             return jnp.matmul(x, w.T)
         finally:
             tracer.set_grad_enabled(og)
-            for p, a in zip(param_objs, old):
+            for p, a in zip(other_objs, old):
                 p._array = a
 
-    def loss_fn(param_arrays, ids, labels):
-        logits = fwd(param_arrays, ids)
+    def loss_fn(params_tree, ids, labels):
+        logits = fwd(params_tree, ids)
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
             logits.astype(jnp.float32), labels[..., None], axis=-1
         )[..., 0]
         return jnp.mean(lse - picked)
 
+    params_tree = (other, stacked)
+    flat_params, treedef = jax.tree_util.tree_flatten(params_tree)
+
+    def _zeros_like_f32(p):
+        z = jnp.zeros(p.shape, jnp.float32)
+        if mesh is not None:
+            z = jax.device_put(z, p.sharding)
+        return z
+
     # AdamW state — moments AND master weights in fp32 even when compute
     # params are bf16 (mixed-precision parity: the reference's
     # multi_precision adam keeps FP32 master params; bf16-only updates round
     # sub-ulp deltas to zero and stall training)
-    low_precision = any(p.dtype != jnp.float32 for p in params)
+    low_precision = any(p.dtype != jnp.float32 for p in flat_params)
     opt_state = {
-        "m": [jnp.zeros(p.shape, jnp.float32) for p in params],
-        "v": [jnp.zeros(p.shape, jnp.float32) for p in params],
+        "m": [_zeros_like_f32(p) for p in flat_params],
+        "v": [_zeros_like_f32(p) for p in flat_params],
         "t": jnp.zeros((), jnp.int32),
     }
     if low_precision:
-        opt_state["master"] = [p.astype(jnp.float32) for p in params]
+        opt_state["master"] = [p.astype(jnp.float32) for p in flat_params]
 
-    def step(params, opt_state, ids, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+    def step(params_tree, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params_tree, ids, labels)
         t = opt_state["t"] + 1
         b1t = 1.0 - beta1 ** t.astype(jnp.float32)
         b2t = 1.0 - beta2 ** t.astype(jnp.float32)
-        masters = opt_state.get("master", params)
+        flat_p = jax.tree_util.tree_leaves(params_tree)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        masters = opt_state.get("master", flat_p)
         new_p, new_m, new_v, new_master = [], [], [], []
-        for p, w32, g, m, v in zip(params, masters, grads, opt_state["m"], opt_state["v"]):
+        for p, w32, g, m, v in zip(flat_p, masters, flat_g,
+                                   opt_state["m"], opt_state["v"]):
             gf = g.astype(jnp.float32)
             m2 = beta1 * m + (1 - beta1) * gf
             v2 = beta2 * v + (1 - beta2) * jnp.square(gf)
@@ -299,7 +387,7 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
         new_state = {"m": new_m, "v": new_v, "t": t}
         if "master" in opt_state:
             new_state["master"] = new_master
-        return new_p, new_state, loss
+        return jax.tree_util.tree_unflatten(treedef, new_p), new_state, loss
 
     step_jit = jax.jit(step, donate_argnums=(0, 1))
-    return step_jit, params, opt_state
+    return step_jit, params_tree, opt_state

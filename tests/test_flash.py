@@ -1,0 +1,101 @@
+"""Pallas flash-attention kernel vs the jnp reference (interpret mode on CPU).
+
+Parity role: numeric checks of the fused attention kernel against the
+unfused composition — OpTest-style (SURVEY.md §4) but for the Pallas tier.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.attention import _sdpa_reference
+from paddle_tpu.kernels import flash
+
+
+def _rand_qkv(rng, b, h, s, d, dtype="float32"):
+    q = rng.randn(b, h, s, d).astype(dtype)
+    k = rng.randn(b, h, s, d).astype(dtype)
+    v = rng.randn(b, h, s, d).astype(dtype)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s,d", [(64, 32), (128, 64)])
+def test_flash_forward_matches_reference(causal, s, d):
+    rng = np.random.RandomState(0)
+    q, k, v = _rand_qkv(rng, 2, 3, s, d)
+    out = flash.flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _sdpa_reference(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_reference(causal):
+    rng = np.random.RandomState(1)
+    q, k, v = _rand_qkv(rng, 1, 2, 64, 32)
+
+    def loss_flash(q, k, v):
+        o = flash.flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = _sdpa_reference(q, k, v, is_causal=causal)
+        return jnp.sum(jnp.sin(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"d{name}")
+
+
+def test_flash_custom_scale():
+    rng = np.random.RandomState(2)
+    q, k, v = _rand_qkv(rng, 1, 1, 64, 32)
+    out = flash.flash_attention(q, k, v, scale=0.5, interpret=True)
+    ref = _sdpa_reference(q, k, v, scale=0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_under_jit_and_vmapless_batch():
+    rng = np.random.RandomState(3)
+    q, k, v = _rand_qkv(rng, 4, 2, 64, 16)
+
+    @jax.jit
+    def f(q, k, v):
+        return flash.flash_attention(q, k, v, causal=True, interpret=True)
+
+    out = f(q, k, v)
+    ref = _sdpa_reference(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_supported_gate():
+    rng = np.random.RandomState(4)
+    q, k, v = _rand_qkv(rng, 1, 1, 64, 16)
+    assert flash.supported(q, k)
+    assert not flash.supported(q, k, mask=jnp.zeros((64, 64)))
+    assert not flash.supported(q, k, dropout_p=0.1)
+    q65 = jnp.asarray(rng.randn(1, 1, 65, 16).astype("float32"))
+    assert not flash.supported(q65, q65)
+
+
+def test_sdpa_dispatch_uses_flash_seamlessly():
+    """The nn.functional path must produce identical math whichever tier runs."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(5)
+    qn = rng.randn(2, 2, 512, 32).astype("float32")
+    q = paddle.to_tensor(qn)
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True, training=False)
+    ref = _sdpa_reference(jnp.asarray(qn), jnp.asarray(qn), jnp.asarray(qn),
+                          is_causal=True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
