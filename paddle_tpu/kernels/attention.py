@@ -50,14 +50,11 @@ def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False,
 def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0, rng=None):
     """Dispatch to the Pallas flash kernel on TPU when profitable, else the
     XLA-fused reference (dropout always takes the reference path)."""
-    if dropout_p == 0.0:
-        try:
-            from . import flash
+    from . import flash
 
-            if flash.available() and mask is None and q.shape[-2] >= 512:
-                return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
-        except ImportError:
-            pass
+    if (flash.available() and q.shape[-2] >= 512
+            and flash.supported(q, k, mask=mask, dropout_p=dropout_p)):
+        return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
     return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal,
                            dropout_p=dropout_p, rng=rng)
 

@@ -31,14 +31,20 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def available() -> bool:
-    """True when the running backend can execute Mosaic/Pallas TPU kernels."""
+def _backend_is_tpu() -> bool:
     try:
         dev = jax.devices()[0]
     except Exception:
         return False
     return dev.platform in ("tpu", "axon") or "TPU" in str(
         getattr(dev, "device_kind", ""))
+
+
+def available() -> bool:
+    """Dispatch gate: True when the running backend can execute Mosaic/Pallas
+    TPU kernels.  (Tests monkeypatch this to force the flash path; the
+    interpret-mode default keys off the backend directly.)"""
+    return _backend_is_tpu()
 
 
 def _pick_block(s: int, want: int = 128):
@@ -295,12 +301,12 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
                     block_q=None, block_k=None):
     """Flash attention over [..., seq, head_dim] (self-attention: q/k same
-    length).  Falls back to None-return contract — callers should check
-    :func:`supported` first; unsupported shapes raise."""
+    length).  Raises ValueError on unsupported shapes — callers should gate on
+    :func:`supported` first (the sdpa dispatcher does)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
-        interpret = not available()
+        interpret = not _backend_is_tpu()
     s_len = q.shape[-2]
     bq = block_q or _pick_block(s_len)
     bk = block_k or _pick_block(s_len)

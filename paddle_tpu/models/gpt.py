@@ -214,7 +214,8 @@ class GPTPretrainingCriterion(nn.Layer):
 
 def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                                 beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
-                                dp_axis="dp", remat: bool = True):
+                                dp_axis="dp", remat: bool = True,
+                                ce_chunk_rows: int = 1024):
     """Compile fwd+bwd+AdamW into ONE donated XLA program over the hybrid mesh.
 
     Returns (step_fn, params, opt_state):
@@ -245,7 +246,11 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
     block_param_objs = [list(b.parameters()) for b in blocks]
     structs = [[(tuple(p.shape), str(p._array.dtype)) for p in ps]
                for ps in block_param_objs]
-    homogeneous = len(blocks) > 1 and all(s == structs[0] for s in structs)
+    # Stack + scan only when a pp axis actually exists: the stacked layout is
+    # what gives pipeline memory scaling, but on a single chip the unrolled
+    # loop schedules ~1.5x faster (XLA fuses across layer boundaries).
+    homogeneous = (pp > 1 and len(blocks) > 1
+                   and all(s == structs[0] for s in structs))
 
     if homogeneous:
         block_ids = {id(p) for ps in block_param_objs for p in ps}
@@ -276,11 +281,16 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
     if homogeneous:
         for j in range(len(block_param_objs[0])):
             leaves = [ps[j]._array for ps in block_param_objs]
-            st = jnp.stack(leaves)
             if mesh is not None:
+                # stack on host, then shard straight from host memory — the
+                # device never holds the full unsharded (L, ...) stack, so
+                # init peak matches the pp-sharded steady state.
+                host = np.stack([np.asarray(a) for a in leaves])
                 lead = "pp" if pp > 1 else None
                 st = jax.device_put(
-                    st, NamedSharding(mesh, P(lead, *_layer_spec(leaves[0]))))
+                    host, NamedSharding(mesh, P(lead, *_layer_spec(leaves[0]))))
+            else:
+                st = jnp.stack(leaves)
             stacked.append(st)
 
     def _constrain_dp(x):
@@ -328,18 +338,47 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                     x = f(x)
             x = model.gpt.ln_f(Tensor(x, stop_gradient=True))._array
             w = model.gpt.embeddings.word_embeddings.weight._array
-            return jnp.matmul(x, w.T)
+            return x, w
         finally:
             tracer.set_grad_enabled(og)
             for p, a in zip(other_objs, old):
                 p._array = a
 
+    def _chunked_softmax_xent(x2, w, labels1, chunk_rows=1024):
+        """CE over a 50k vocab without ever materializing (tokens, vocab)
+        logits: the LM-head matmul runs inside a remat'd scan chunk, so peak
+        HBM is chunk_rows*vocab*4 instead of tokens*vocab*4 (the round-1
+        compile-OOM cause).  Kernel-role parity:
+        softmax_with_cross_entropy_op.cu (997 LoC fused CUDA)."""
+        n, h = x2.shape
+        c = min(chunk_rows, n)
+        while n % c:
+            c //= 2
+        k = n // c
+
+        def body(tot, inp):
+            xc, lc = inp
+            logits = jnp.dot(xc, w.T, preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+            return tot + jnp.sum(lse - picked), None
+
+        tot, _ = lax.scan(
+            jax.checkpoint(body), jnp.zeros((), jnp.float32),
+            (x2.reshape(k, c, h), labels1.reshape(k, c)))
+        return tot / n
+
     def loss_fn(params_tree, ids, labels):
-        logits = fwd(params_tree, ids)
+        x, w = fwd(params_tree, ids)
+        b, s, h = x.shape
+        if ce_chunk_rows:
+            return _chunked_softmax_xent(x.reshape(b * s, h), w,
+                                         labels.reshape(b * s),
+                                         chunk_rows=ce_chunk_rows)
+        logits = jnp.matmul(x, w.T)
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
-            logits.astype(jnp.float32), labels[..., None], axis=-1
-        )[..., 0]
+            logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
         return jnp.mean(lse - picked)
 
     params_tree = (other, stacked)

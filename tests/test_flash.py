@@ -87,13 +87,40 @@ def test_supported_gate():
     assert not flash.supported(q65, q65)
 
 
-def test_sdpa_dispatch_uses_flash_seamlessly():
-    """The nn.functional path must produce identical math whichever tier runs."""
+def test_sdpa_dispatch_uses_flash_seamlessly(monkeypatch):
+    """The nn.functional path must route through the flash kernel when the
+    gate opens, and produce the reference math (interpret mode on CPU)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
 
+    calls = []
+    real_flash_attention = flash.flash_attention
+
+    def spy(q, k, v, **kw):
+        calls.append(q.shape)
+        return real_flash_attention(q, k, v, **kw)
+
+    monkeypatch.setattr(flash, "available", lambda: True)
+    monkeypatch.setattr(flash, "flash_attention", spy)
+
     rng = np.random.RandomState(5)
     qn = rng.randn(2, 2, 512, 32).astype("float32")
+    q = paddle.to_tensor(qn)
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True, training=False)
+    assert calls, "flash path was not taken by the dispatcher"
+    ref = _sdpa_reference(jnp.asarray(qn), jnp.asarray(qn), jnp.asarray(qn),
+                          is_causal=True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_sdpa_dispatch_falls_back_on_unsupported_shape(monkeypatch):
+    """Odd seq lens must take the reference path, not crash (supported() gate)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    monkeypatch.setattr(flash, "available", lambda: True)
+    rng = np.random.RandomState(6)
+    qn = rng.randn(1, 2, 700, 16).astype("float32")
     q = paddle.to_tensor(qn)
     out = F.scaled_dot_product_attention(q, q, q, is_causal=True, training=False)
     ref = _sdpa_reference(jnp.asarray(qn), jnp.asarray(qn), jnp.asarray(qn),
