@@ -105,19 +105,37 @@ amp_guard = auto_cast
 def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
              master_weight=None, save_dtype=None):
     """Parity: paddle.amp.decorate — O2 casts model params to low precision
-    (optimizer keeps fp32 master state via its fp32 accumulators)."""
+    while the optimizer keeps FP32 MASTER WEIGHTS (reference
+    multi_precision/MasterParam path): masters are seeded from the pristine
+    fp32 values BEFORE the cast, updates run on the masters, and the low-
+    precision params mirror them each step — bf16-only updates would round
+    small deltas to zero and stall training (ADVICE round 1)."""
     import jax.numpy as jnp_
+
+    from ..dygraph.tensor import Tensor
 
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = ([] if optimizers is None
+                else [optimizers] if opt_single else list(optimizers))
     if level.upper() == "O2":
         target = jnp_.bfloat16 if dtype == "bfloat16" else jnp_.float16
+        use_master = master_weight is not False
         for m in model_list:
             if m is None:
                 continue
             for p in m.parameters():
-                if jnp_.issubdtype(p._array.dtype, jnp_.floating):
-                    p._array = p._array.astype(target)
+                if not jnp_.issubdtype(p._array.dtype, jnp_.floating):
+                    continue
+                if use_master and p._array.dtype == jnp_.float32:
+                    for o in opt_list:
+                        # seed while the param is still pristine fp32
+                        o._master_weight(p)
+                p._array = p._array.astype(target)
+        if use_master:
+            for o in opt_list:
+                o._multi_precision = True
     if optimizers is None:
         return models if single else model_list
     return (models if single else model_list), optimizers

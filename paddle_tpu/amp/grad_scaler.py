@@ -73,21 +73,27 @@ class GradScaler:
         self._already_unscaled = True
 
     def step(self, optimizer):
+        """Does NOT advance the loss-scale state machine — the paddle 2.x
+        recipe is ``scaler.step(opt); scaler.update()`` (reference step() has
+        no update; ADVICE round 1: calling it here double-stepped the scale)."""
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
-        self._already_unscaled = False
         if not (self._enable and self._dynamic):
+            self._already_unscaled = False
             return
+        if not self._already_unscaled:
+            return  # no unscale since last update — nothing to record
+        self._already_unscaled = False
         if self._found_inf:
             self._bad += 1
             self._good = 0

@@ -74,6 +74,13 @@ def _run_record_backward(
         outs = rec.outputs_list
         if create_graph:
             n_in = len(rec.inputs_list)
+            # substitute detached snapshots for any input mutated since trace
+            # (value-correct; the mutated tensor's history was re-homed to a
+            # clone, so the live object is the wrong node anyway)
+            ins_list = [
+                t if t._array is arr else Tensor(arr, stop_gradient=True)
+                for t, arr in zip(rec.inputs_list, rec.in_arrays)
+            ]
             ct_tensors = []
             for t in outs:
                 g = _get_grad(grad_map, t)
@@ -91,14 +98,14 @@ def _run_record_backward(
                 _, vjp_fn = jax.vjp(_fn, *prim)
                 return vjp_fn(cts[0] if _single else tuple(cts))
 
-            grads = tracer.trace_fn(_bfn, list(rec.inputs_list) + ct_tensors, name="pyfunc_grad")
+            grads = tracer.trace_fn(_bfn, ins_list + ct_tensors, name="pyfunc_grad")
             if not isinstance(grads, (list, tuple)):
                 grads = [grads]
             for t, g in zip(rec.inputs_list, grads):
                 if not t.stop_gradient and id(t) not in no_grad_ids and g is not None:
                     _accum(grad_map, t, g)
             return
-        arrays = [t._array for t in rec.inputs_list]
+        arrays = rec.in_arrays  # trace-time snapshots (inplace-safe)
         _, vjp_fn = jax.vjp(rec.fn, *arrays)
         cts = []
         for t in outs:
@@ -156,7 +163,14 @@ def _run_record_backward(
             # run the grad kernel through trace_fn so grad-of-grad is taped
             # (vjp-of-vjp; works to arbitrary order)
             order = [(slot, i) for slot, vals in ins_t.items() for i in range(len(vals))]
-            tensors = [ins_t[s][i] for s, i in order]
+
+            def _snap_t(t):
+                arr = rec.snap.get(id(t))
+                if arr is None or arr is t._array:
+                    return t
+                return Tensor(arr, stop_gradient=True)
+
+            tensors = [_snap_t(ins_t[s][i]) for s, i in order]
             out_slots = list(gop["outputs"])
 
             def _fn(*arrays, _order=order, _attrs=attrs, _gd=grad_def, _rng=rec.rng, _os=out_slots):
@@ -176,7 +190,10 @@ def _run_record_backward(
                 outs[s] = flat[k : k + n_out]
                 k += n_out
         else:
-            ins = {s: [t._array for t in vals] for s, vals in ins_t.items()}
+            # read forward tensors through the record's trace-time snapshots
+            # so in-place mutation after the op cannot corrupt its grads
+            ins = {s: [rec.snap.get(id(t), t._array) for t in vals]
+                   for s, vals in ins_t.items()}
             outs = tracer.run_eager_kernel(gop["type"], ins, attrs, rng=rec.rng)
         for slot, names in gop["outputs"].items():
             vals = outs.get(slot, [])
@@ -239,6 +256,9 @@ def run_backward(
         if g is None:
             continue
         g_arr = g._array if isinstance(g, Tensor) else g
+        # inplace-mutation clones route their grad to the user's tensor
+        while getattr(t, "_alias_of", None) is not None:
+            t = t._alias_of
         if t._grad is None:
             t._grad = Tensor(g_arr, stop_gradient=True)
         else:
@@ -257,12 +277,14 @@ def _release(rec):
             t.grad_node = None
         rec.inputs_list = []
         rec.outputs_list = []
+        rec.in_arrays = []
     else:
         for ts in rec.outputs.values():
             for t in ts:
                 t.grad_node = None
         rec.inputs = {}
         rec.outputs = {}
+        rec.snap = {}
 
 
 def calc_gradient(

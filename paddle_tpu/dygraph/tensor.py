@@ -144,9 +144,58 @@ class Tensor:
         return tracer.trace_op("assign", {"X": [self]}, {})["Out"][0]
 
     # -- mutation (parity: VarBase set_value / optimizer in-place ops) ----
+    def _taped_inplace(self, fn, tensor_inputs, name="set_value"):
+        """Version-bump an in-place update through the tape: the pre-mutation
+        value becomes a clone that carries the old history, the update is a
+        recorded op whose OUTPUT is this tensor, so downstream consumers and
+        backward both see consistent values (parity: the reference's
+        set_value grad op + inplace version counters, which catch exactly the
+        silent-wrong-gradient mutation this prevents)."""
+        old = Tensor(self._array, stop_gradient=self.stop_gradient)
+        prev = self.grad_node
+        old.grad_node = prev
+        # if self was a LEAF, the clone inherits leaf-ness — route its .grad
+        # back to the user-visible tensor at backward time (engine follows
+        # _alias_of when writing leaf grads)
+        old._alias_of = self
+
+        def _swap(ts):
+            return [old if t is self else t for t in ts]
+
+        if prev is not None:
+            # the producing record must now emit the CLONE, so its output
+            # gradient is read from the pre-mutation value's accumulator
+            if isinstance(prev, tracer.PyFuncRecord):
+                prev.outputs_list = _swap(prev.outputs_list)
+            else:
+                for slot, ts in prev.outputs.items():
+                    prev.outputs[slot] = _swap(ts)
+        # records that consumed the pre-mutation value now consume the clone
+        cons = self.__dict__.pop("_consumers", None)
+        if cons:
+            for wr in cons:
+                r = wr()
+                if r is None:
+                    continue
+                if isinstance(r, tracer.PyFuncRecord):
+                    r.inputs_list = _swap(r.inputs_list)
+                else:
+                    for slot, ts in r.inputs.items():
+                        r.inputs[slot] = _swap(ts)
+            old._consumers = cons
+        out = tracer.trace_fn(fn, [old] + list(tensor_inputs), name=name)
+        rec = out.grad_node
+        if rec is not None:
+            rec.outputs_list = [self]
+        self._array = out._array
+        self.grad_node = rec
+        return self
+
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._array
+        # full overwrite: no gradient flows through the old value — detach
+        self.grad_node = None
         self._array = jnp.asarray(value, self._array.dtype).reshape(self._array.shape)
 
     def copy_(self, other, blocking=True):
@@ -154,14 +203,18 @@ class Tensor:
         return self
 
     def fill_(self, value):
+        self.grad_node = None
         self._array = jnp.full_like(self._array, value)
         return self
 
     def zero_(self):
+        self.grad_node = None
         self._array = jnp.zeros_like(self._array)
         return self
 
     def scale_(self, scale):
+        if tracer.has_grad() and self.grad_node is not None:
+            return self._taped_inplace(lambda a: a * scale, [], name="scale_")
         self._array = self._array * scale
         return self
 
@@ -202,8 +255,22 @@ class Tensor:
 
     def __setitem__(self, idx, value):
         idx = _normalize_index(idx)
-        v = value._array if isinstance(value, Tensor) else jnp.asarray(value, self._array.dtype)
-        self._array = self._array.at[idx].set(v)
+        vt = value if isinstance(value, Tensor) else None
+        # tape the write when this tensor is already an autograd intermediate
+        # or the value itself needs grad — otherwise grads would silently be
+        # computed against the post-mutation buffer (ADVICE round 1)
+        if tracer.has_grad() and (
+                self.grad_node is not None
+                or (vt is not None and not vt.stop_gradient)):
+            if vt is not None:
+                self._taped_inplace(
+                    lambda a, v: a.at[idx].set(v.astype(a.dtype)), [vt])
+            else:
+                varr = jnp.asarray(value, self._array.dtype)
+                self._taped_inplace(lambda a: a.at[idx].set(varr), [])
+            return
+        v = vt._array if vt is not None else jnp.asarray(value)
+        self._array = self._array.at[idx].set(v.astype(self._array.dtype))
 
     def __iter__(self):
         for i in range(len(self)):

@@ -51,9 +51,15 @@ def set_amp_state(st) -> None:
 
 
 class GradRecord:
-    """One taped forward op (parity: OpBase + GradOpNode, op_base.h:33,202)."""
+    """One taped forward op (parity: OpBase + GradOpNode, op_base.h:33,202).
 
-    __slots__ = ("seq", "type", "inputs", "outputs", "attrs", "rng")
+    ``snap`` pins the array VALUES of every involved tensor at trace time
+    (free: jax arrays are immutable, this stores references) so later
+    in-place mutation of a tensor cannot corrupt backward — the version-
+    counter guarantee the reference gets from VarBase inplace_version."""
+
+    __slots__ = ("seq", "type", "inputs", "outputs", "attrs", "rng", "snap",
+                 "__weakref__")
 
     _counter = [0]
 
@@ -65,6 +71,10 @@ class GradRecord:
         self.outputs = outputs  # slot -> list[Tensor]
         self.attrs = attrs
         self.rng = rng
+        self.snap = {}
+        for ts in list(inputs.values()) + list(outputs.values()):
+            for t in ts:
+                self.snap[id(t)] = t._array
 
     # Operator-duck-type for registry.make_grad_op_descs
     def input(self, slot):
@@ -181,7 +191,27 @@ def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any]):
             if slot not in op_def.nondiff_out_slots:
                 for t in ts:
                     t.grad_node = rec
+        _register_consumers(rec, (t for ts in norm.values() for t in ts))
     return out_tensors
+
+
+def _register_consumers(rec, tensors):
+    """Weakly index which records consume each tensor, so taped in-place
+    mutation (Tensor._taped_inplace) can re-point prior consumers at the
+    pre-mutation clone (the reference's inplace_version bookkeeping role)."""
+    import weakref
+
+    wr = weakref.ref(rec)
+    for t in tensors:
+        lst = t.__dict__.get("_consumers")
+        if lst is None:
+            lst = t._consumers = []
+        lst.append(wr)
+        # compact dead refs at power-of-two sizes — keeps long-lived params'
+        # consumer lists O(live records), not O(total ops ever)
+        n = len(lst)
+        if n >= 64 and (n & (n - 1)) == 0:
+            lst[:] = [w for w in lst if w() is not None]
 
 
 def trace_fn(fn, tensors: List, name: str = "pyfunc"):
@@ -212,6 +242,7 @@ def trace_fn(fn, tensors: List, name: str = "pyfunc"):
         rec = PyFuncRecord(fn, tensors, outs, single)
         for t in outs:
             t.grad_node = rec
+        _register_consumers(rec, tensors)
     return outs[0] if single else outs
 
 
@@ -244,9 +275,11 @@ def _trace_fn_static(fn, tensors, name):
 
 
 class PyFuncRecord:
-    """Tape node for trace_fn closures (PyLayer-style custom autograd)."""
+    """Tape node for trace_fn closures (PyLayer-style custom autograd).
+    ``in_arrays`` snapshots input values at trace time (see GradRecord.snap)."""
 
-    __slots__ = ("seq", "fn", "inputs_list", "outputs_list", "single")
+    __slots__ = ("seq", "fn", "inputs_list", "outputs_list", "single",
+                 "in_arrays", "__weakref__")
 
     def __init__(self, fn, inputs_list, outputs_list, single):
         GradRecord._counter[0] += 1
@@ -255,3 +288,4 @@ class PyFuncRecord:
         self.inputs_list = inputs_list
         self.outputs_list = outputs_list
         self.single = single
+        self.in_arrays = [t._array for t in inputs_list]

@@ -56,6 +56,10 @@ class Optimizer:
         # accumulators: acc_name -> param_name -> Tensor (dygraph) / Variable (static)
         self._accumulators: Dict[str, Dict[str, object]] = {}
         self._lr_var = None  # static-mode persistable lr var
+        # fp16/bf16 params keep an fp32 master copy (reference multi_precision
+        # adam: MasterParam in/out) — enabled by the optimizer arg or by
+        # amp.decorate(level='O2')
+        self._multi_precision = False
 
     # -- lr ---------------------------------------------------------------
     def get_lr(self) -> float:
@@ -125,6 +129,37 @@ class Optimizer:
         store[pname] = acc
         return acc
 
+    # -- fp32 master weights (multi_precision parity) ----------------------
+    def _master_weight(self, p):
+        """fp32 master copy for a low-precision param (created from the
+        current value on first touch; amp.decorate pre-seeds it from the
+        pristine fp32 weights before casting)."""
+        import jax.numpy as jnp
+
+        store = self._accumulators.setdefault("master_weight", {})
+        mw = store.get(p.name)
+        if mw is None:
+            mw = Tensor(p._array.astype(jnp.float32), stop_gradient=True)
+            mw.name = p.name  # alias so per-param accumulators keep their keys
+            store[p.name] = mw
+        return mw
+
+    def _update_target(self, p):
+        """Returns (target, finalize): the tensor the update kernel should
+        write (master when multi_precision applies) and a callback that
+        mirrors the new master value into the low-precision param."""
+        import jax.numpy as jnp
+
+        if (self._multi_precision and fw.in_dygraph_mode()
+                and p._array.dtype in (jnp.float16, jnp.bfloat16)):
+            mw = self._master_weight(p)
+
+            def finalize():
+                p._array = mw._array.astype(p._array.dtype)
+
+            return mw, finalize
+        return p, None
+
     # -- the shared update executor ---------------------------------------
     def _run_update(self, op_type: str, ins: Dict[str, list], bind: Dict[str, object],
                     attrs: Dict[str, object]):
@@ -182,7 +217,7 @@ class Optimizer:
         params_grads = self._apply_regularization(params_grads)
         params_grads = self._apply_clip(params_grads)
         for p, g in params_grads:
-            self._append_optimize_op(p, g)
+            self._apply_optimize_op(p, g)
 
     def clear_grad(self):
         if self._parameter_list:
@@ -201,38 +236,70 @@ class Optimizer:
         params_grads = self._apply_regularization(params_grads)
         params_grads = self._apply_clip(params_grads)
         for p, g in params_grads:
-            self._append_optimize_op(p, g)
+            self._apply_optimize_op(p, g)
         return None, params_grads
 
     def apply_gradients(self, params_grads):
         params_grads = self._apply_regularization(params_grads)
         params_grads = self._apply_clip(params_grads)
         for p, g in params_grads:
-            self._append_optimize_op(p, g)
+            self._apply_optimize_op(p, g)
+
+    def _apply_optimize_op(self, p, g):
+        target, finalize = self._update_target(p)
+        self._append_optimize_op(target, g)
+        if finalize is not None:
+            finalize()
 
     def _append_optimize_op(self, param, grad):
         raise NotImplementedError
 
     # -- state dict --------------------------------------------------------
     def state_dict(self):
+        """Keys follow the reference's accumulator-variable naming
+        ``{param}_{acc}_0`` (e.g. ``linear_0.w_0_moment1_0``) so .pdopt files
+        interchange with reference-produced checkpoints."""
         d = {}
         for acc_name, store in self._accumulators.items():
             for pname, acc in store.items():
-                d[f"{pname}/{acc_name}"] = acc
+                d[f"{pname}_{acc_name}_0"] = acc
         if isinstance(self._learning_rate, LRScheduler):
             d["LR_Scheduler"] = self._learning_rate.state_dict()
         return d
 
+    def _find_accumulator(self, key):
+        """Resolve a state key in either the reference format
+        ``{param}_{acc}_0`` or the legacy round-1 format ``{param}/{acc}``."""
+        if "/" in key:
+            pname, acc_name = key.rsplit("/", 1)
+            return self._accumulators.get(acc_name, {}).get(pname)
+        for acc_name, store in self._accumulators.items():
+            suffix = f"_{acc_name}_0"
+            if key.endswith(suffix):
+                tgt = store.get(key[: -len(suffix)])
+                if tgt is not None:
+                    return tgt
+        return None
+
     def set_state_dict(self, state):
+        unmatched = []
         for key, val in state.items():
             if key == "LR_Scheduler":
                 if isinstance(self._learning_rate, LRScheduler):
                     self._learning_rate.set_state_dict(val)
                 continue
-            pname, acc_name = key.rsplit("/", 1)
-            tgt = self._accumulators.get(acc_name, {}).get(pname)
+            tgt = self._find_accumulator(key)
             if tgt is not None and isinstance(tgt, Tensor):
                 tgt.set_value(val.numpy() if hasattr(val, "numpy") else val)
+            else:
+                unmatched.append(key)
+        if unmatched:
+            import warnings
+
+            warnings.warn(
+                f"optimizer.set_state_dict: {len(unmatched)} key(s) did not "
+                f"match any accumulator and were ignored: {unmatched[:8]}"
+                + ("..." if len(unmatched) > 8 else ""))
 
     set_dict = set_state_dict
 
@@ -272,9 +339,10 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None,
-                 lazy_mode=False, name=None):
+                 lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._multi_precision = bool(multi_precision)
 
     _op = "adam"
 
@@ -303,7 +371,7 @@ class AdamW(Adam):
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, name)
+                         None, grad_clip, lazy_mode, multi_precision, name)
         if isinstance(weight_decay, (int, float)) and not isinstance(weight_decay, bool):
             self._coeff = float(weight_decay)
         else:

@@ -137,7 +137,10 @@ class LinearWarmup(LRScheduler):
         if self.last_epoch < self.warmup_steps:
             return (self.end_lr - self.start_lr) * self.last_epoch / self.warmup_steps + self.start_lr
         if self.lr_sched is not None:
-            self.lr_sched.step()
+            # pin the wrapped scheduler to this scheduler's epoch (reference
+            # behavior) — extra get_lr() calls or step(epoch=...) resumes
+            # stay in sync instead of free-running
+            self.lr_sched.step(self.last_epoch - self.warmup_steps)
             return self.lr_sched()
         return self.final_lr
 

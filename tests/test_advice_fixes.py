@@ -1,0 +1,170 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+Covers: taped __setitem__ gradients, fp32 master weights under
+amp.decorate(O2), GradScaler step/update state machine, LinearWarmup inner
+scheduler pinning, reference-format optimizer state_dict keys.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp
+
+
+def test_setitem_grad_flows_to_value_and_masks_old():
+    """dL/dvalue must be the gradient at the written slice; dL/dx must be
+    zero there (set_value grad-op parity)."""
+    x = paddle.to_tensor(np.ones((3, 3), "float32"), stop_gradient=False)
+    v = paddle.to_tensor(np.full((3,), 5.0, "float32"), stop_gradient=False)
+    y = x * 2.0
+    y[1] = v
+    out = (y * paddle.to_tensor(np.arange(9, dtype="float32").reshape(3, 3))).sum()
+    out.backward()
+    # grads wrt v: the weights at row 1 = [3,4,5]
+    np.testing.assert_allclose(v.grad.numpy(), [3.0, 4.0, 5.0])
+    gx = x.grad.numpy()
+    np.testing.assert_allclose(gx[1], np.zeros(3))          # overwritten row
+    np.testing.assert_allclose(gx[0], 2.0 * np.array([0., 1., 2.]))
+    np.testing.assert_allclose(gx[2], 2.0 * np.array([6., 7., 8.]))
+
+
+def test_setitem_on_leaf_keeps_grad_on_user_tensor():
+    """A leaf that is mutated in place must still receive .grad (routed back
+    from the pre-mutation clone)."""
+    x = paddle.to_tensor(np.ones((3, 2), "float32"), stop_gradient=False)
+    v = paddle.to_tensor(np.zeros((2,), "float32"), stop_gradient=False)
+    x[1] = v
+    (x * 2.0).sum().backward()
+    assert x.grad is not None
+    gx = x.grad.numpy()
+    np.testing.assert_allclose(gx[0], [2.0, 2.0])
+    np.testing.assert_allclose(gx[1], [0.0, 0.0])  # overwritten row
+    np.testing.assert_allclose(gx[2], [2.0, 2.0])
+    np.testing.assert_allclose(v.grad.numpy(), [2.0, 2.0])
+
+
+def test_setitem_after_use_does_not_corrupt_backward():
+    """Mutating an intermediate AFTER it fed another op must not change that
+    op's gradients (the round-1 silent-wrong-gradient bug)."""
+    x = paddle.to_tensor(np.ones((2, 2), "float32"), stop_gradient=False)
+    y = x * 3.0
+    z = y * y          # consumes y's CURRENT (pre-mutation) value
+    y[0, 0] = 100.0    # in-place write afterwards
+    z.sum().backward()
+    # dz/dx = 2*y*3 evaluated at pre-mutation y == 18
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 18.0))
+
+
+def test_amp_decorate_o2_keeps_master_weights():
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    o = opt.AdamW(learning_rate=1e-4, parameters=net.parameters())
+    net, o = amp.decorate(models=net, optimizers=o, level="O2", dtype="bfloat16")
+    assert net.weight._array.dtype == jnp.bfloat16
+    assert o._multi_precision
+    masters = o._accumulators["master_weight"]
+    assert net.weight.name in masters
+    assert masters[net.weight.name]._array.dtype == jnp.float32
+
+    rng = np.random.RandomState(0)
+    w0_master = np.asarray(masters[net.weight.name]._array).copy()
+    for _ in range(3):
+        x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+        loss = net(x).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    w_master = np.asarray(masters[net.weight.name]._array)
+    # master moved in fp32 and the bf16 param mirrors it
+    assert not np.allclose(w_master, w0_master)
+    np.testing.assert_allclose(
+        np.asarray(net.weight._array, dtype=np.float32),
+        w_master.astype(np.float32), rtol=1e-2, atol=1e-2)
+
+
+def test_amp_o2_tiny_updates_not_lost():
+    """fp32 masters accumulate updates far below bf16 ulp (the drift ADVICE
+    flagged): 100 steps of 1e-5-scale SGD-like updates must register."""
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    net = nn.Linear(2, 1, bias_attr=False)
+    net.weight.set_value(np.ones((2, 1), "float32"))
+    o = opt.Momentum(learning_rate=1e-6, momentum=0.0, parameters=net.parameters())
+    net, o = amp.decorate(models=net, optimizers=o, level="O2", dtype="bfloat16")
+    x = paddle.to_tensor(np.ones((1, 2), "float32"))
+    for _ in range(100):
+        net(x).sum().backward()
+        o.step()
+        o.clear_grad()
+    master = np.asarray(o._accumulators["master_weight"][net.weight.name]._array)
+    # 100 * 1e-6 * grad(=1) = 1e-4 total movement, far below bf16 resolution
+    np.testing.assert_allclose(master, 1.0 - 1e-4, rtol=1e-3)
+
+
+def test_grad_scaler_step_does_not_double_update():
+    paddle.seed(0)
+    net = nn.Linear(2, 1)
+    o = opt.SGD(0.1, parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=2.0**10, incr_every_n_steps=4)
+    goods = []
+    for i in range(3):
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        loss = net(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(o)       # must NOT advance the state machine
+        scaler.update()      # the one true advance
+        o.clear_grad()
+        goods.append(scaler._good)
+    assert goods == [1, 2, 3]  # one increment per iteration, not two
+    assert scaler._scale == 2.0**10  # incr_every=4 not yet reached
+
+
+def test_linear_warmup_pins_inner_scheduler():
+    inner = opt.lr.ExponentialDecay(learning_rate=1.0, gamma=0.5)
+    s = opt.lr.LinearWarmup(inner, warmup_steps=2, start_lr=0.0, end_lr=1.0)
+    # extra get_lr() calls must not advance the post-warmup schedule
+    for _ in range(5):
+        s.get_lr()
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    # epochs 0,1 warmup; epoch >= 2 -> inner pinned at epoch-2
+    np.testing.assert_allclose(vals, [0.0, 0.5, 1.0, 0.5, 0.25])
+    # resume at an arbitrary epoch stays consistent
+    s.step(epoch=4)
+    np.testing.assert_allclose(s(), 0.25)
+
+
+def test_optimizer_state_dict_reference_keys():
+    paddle.seed(0)
+    net = nn.Linear(3, 3)
+    o = opt.Adam(0.01, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    net(x).sum().backward()
+    o.step()
+    o.clear_grad()
+    sd = o.state_dict()
+    wname = net.weight.name
+    assert f"{wname}_moment1_0" in sd, list(sd)
+    assert f"{wname}_moment2_0" in sd
+    # roundtrip through the reference format
+    o2 = opt.Adam(0.01, parameters=net.parameters())
+    net(x).sum().backward()
+    o2.step()
+    o2.clear_grad()
+    o2.set_state_dict({k: v for k, v in sd.items()})
+    np.testing.assert_allclose(
+        np.asarray(o2._accumulators["moment1"][wname]._array),
+        np.asarray(o._accumulators["moment1"][wname]._array))
+    # unknown keys warn instead of silently dropping
+    with pytest.warns(UserWarning, match="did not match"):
+        o2.set_state_dict({"nonexistent_param_moment1_0": sd[f"{wname}_moment1_0"]})

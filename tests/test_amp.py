@@ -48,6 +48,7 @@ def test_grad_scaler_fp16_dynamic():
     scaled.backward()
     w0 = l.weight.numpy().copy()
     scaler.step(o)
+    scaler.update()  # paddle 2.x recipe: step() must NOT advance the scale
     o.clear_grad()
     # grads were unscaled before the update: equal to unscaled grad * lr
     assert not np.allclose(w0, l.weight.numpy())
@@ -57,6 +58,7 @@ def test_grad_scaler_fp16_dynamic():
     l.weight.grad._array = l.weight.grad._array * np.inf
     w1 = l.weight.numpy().copy()
     scaler.step(o)
+    scaler.update()
     np.testing.assert_allclose(w1, l.weight.numpy())
     assert scaler.get_loss_scaling() == 2.0
 
