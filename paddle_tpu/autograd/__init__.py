@@ -1,0 +1,113 @@
+"""``paddle.autograd`` — PyLayer custom autograd + backward-mode entry.
+
+Parity: ``/root/reference/python/paddle/autograd/py_layer.py:1`` (PyLayer,
+PyLayerContext, LayerMeta/apply machinery over ``core.pylayer_apply``) and
+``autograd/backward_mode.py`` (``paddle.autograd.backward``).
+
+TPU-first: instead of a C++ ``py_layer`` op (imperative/py_layer_fwd.h), the
+custom pair is a :class:`~paddle_tpu.dygraph.tracer.PyLayerRecord` tape node
+— the backward engine calls the user's ``backward`` staticmethod directly,
+re-taping it when ``create_graph`` so double-grad through a PyLayer works.
+"""
+
+from __future__ import annotations
+
+from ..dygraph import tracer
+from ..dygraph.engine import run_backward, calc_gradient
+from ..dygraph.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad"]
+
+
+class PyLayerContext:
+    """Context passed as the first argument of forward/backward
+    (py_layer.py:21).  ``save_for_backward``/``saved_tensor`` move tensors
+    across; arbitrary attributes may be attached (``ctx.foo = ...``)."""
+
+    def __init__(self):
+        self.container = None
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    def saved_tensor(self):
+        return self.container
+
+
+class LayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=LayerMeta):
+    """Custom autograd block: subclass with ``forward(ctx, *args)`` and
+    ``backward(ctx, *output_grads)`` staticmethods, run via ``apply``
+    (py_layer.py:189 contract: #backward inputs == #forward tensor outputs,
+    #backward outputs == #forward tensor inputs)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [
+            a for a in list(args) + list(kwargs.values()) if isinstance(a, Tensor)
+        ]
+        requires_grad = tracer.has_grad() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        old = tracer.set_grad_enabled(False)
+        try:
+            outputs = cls.forward(ctx, *args, **kwargs)
+        finally:
+            tracer.set_grad_enabled(old)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        tensor_outs = [t for t in outs if isinstance(t, Tensor)]
+        if requires_grad and tensor_outs:
+            rec = tracer.PyLayerRecord(cls, ctx, tensor_inputs, tensor_outs)
+            for t in tensor_outs:
+                t.stop_gradient = False
+                t.grad_node = rec
+        return outputs
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward`` (backward_mode.py:20): accumulate grads
+    of ``tensors`` into their leaves' ``.grad``."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    tensors = list(tensors)
+    assert len({id(t) for t in tensors}) == len(tensors), (
+        "tensors must not contain the same tensor twice")
+    if grad_tensors is not None:
+        if isinstance(grad_tensors, Tensor):
+            grad_tensors = [grad_tensors]
+        grad_tensors = list(grad_tensors)
+        assert len(grad_tensors) == len(tensors), (
+            "grad_tensors must match tensors in length")
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` — partial_grad_engine.cc parity (re-export)."""
+    single_out = isinstance(outputs, Tensor)
+    single_in = isinstance(inputs, Tensor)
+    outs = [outputs] if single_out else list(outputs)
+    ins = [inputs] if single_in else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    res = calc_gradient(
+        outs, ins, grad_outputs, retain_graph=retain_graph,
+        create_graph=create_graph, allow_unused=allow_unused,
+        no_grad_vars=no_grad_vars,
+    )
+    return res

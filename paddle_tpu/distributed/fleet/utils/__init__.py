@@ -1,0 +1,9 @@
+"""fleet.utils — recompute and helper utilities.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/utils/__init__.py``.
+"""
+
+from . import recompute as recompute_mod  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+__all__ = ["recompute"]

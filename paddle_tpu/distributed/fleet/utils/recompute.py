@@ -1,0 +1,103 @@
+"""Dygraph activation recompute built on PyLayer.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/utils/recompute.py:63``
+(``RecomputeFunction(PyLayer)``: forward under no_grad saving inputs + RNG
+state; backward replays the function with gradients enabled under the saved
+RNG state, runs autograd over the replayed subgraph, and returns the input
+grads).
+
+TPU-first note: inside jit-compiled train steps ``jax.checkpoint`` is the
+native remat mechanism (models/gpt.py); this module serves the EAGER dygraph
+API so reference training scripts using ``fleet.utils.recompute`` run
+unchanged.
+"""
+
+from __future__ import annotations
+
+from ....dygraph.tensor import Tensor
+from ....autograd import PyLayer
+from ....dygraph import tracer
+from ....framework import random as frandom
+
+
+def check_recompute_necessary(inputs):
+    if not any(isinstance(x, Tensor) and not x.stop_gradient for x in inputs):
+        import warnings
+
+        warnings.warn(
+            "[Recompute]: None of the inputs to current recompute block need "
+            "grad; there is NO need to recompute this block in backward")
+
+
+class RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        check_recompute_necessary(args)
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+
+        ctx.inputs = []
+        ctx.tensor_indices = []
+        tensor_inputs = []
+        for i, arg in enumerate(args):
+            if isinstance(arg, Tensor):
+                tensor_inputs.append(arg)
+                ctx.tensor_indices.append(i)
+                ctx.inputs.append(None)
+            else:
+                ctx.inputs.append(arg)
+        ctx.save_for_backward(*tensor_inputs)
+        # dropout replay: snapshot the framework RNG key (the reference saves
+        # the CUDA RNG state; here a jax PRNGKey)
+        if preserve_rng_state:
+            ctx.fw_rng_state = frandom.get_rng_state()
+        ctx.amp_state = tracer.amp_state()
+
+        outputs = run_function(*args)  # apply() already disabled grads
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *output_grads):
+        from ....autograd import backward as autograd_backward
+        from ....amp.auto_cast import auto_cast
+
+        inputs = list(ctx.inputs)
+        detached = []
+        for i, idx in enumerate(ctx.tensor_indices):
+            saved = ctx.saved_tensor()[i]
+            d = Tensor(saved._array, stop_gradient=saved.stop_gradient)
+            inputs[idx] = d
+            detached.append(d)
+
+        old_rng = None
+        if ctx.preserve_rng_state:
+            old_rng = frandom.get_rng_state()
+            frandom.set_rng_state(ctx.fw_rng_state)
+        old_grad = tracer.set_grad_enabled(True)
+        old_amp = tracer.amp_state()
+        tracer.set_amp_state(ctx.amp_state)
+        try:
+            outputs = ctx.run_function(*inputs)
+        finally:
+            tracer.set_amp_state(old_amp)
+            tracer.set_grad_enabled(old_grad)
+            if old_rng is not None:
+                frandom.set_rng_state(old_rng)
+
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        tensor_outs = [t for t in outs if isinstance(t, Tensor)]
+        grads = [g for t, g in zip(tensor_outs, output_grads)]
+        autograd_backward(tensor_outs, grads)
+        return tuple(
+            d.grad if d.grad is not None else None for d in detached
+        )
+
+
+def recompute(function, *args, **kwargs):
+    """``fleet.utils.recompute(fn, *args)`` — recompute fn's activations in
+    backward instead of storing them (recompute.py:171 parity)."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    if kwargs:
+        raise ValueError(f"Unexpected kwargs: {list(kwargs)}")
+    return RecomputeFunction.apply(function, preserve, *args)

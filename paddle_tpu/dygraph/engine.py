@@ -33,7 +33,7 @@ def _collect_records(roots) -> List:
             continue
         seen.add(id(rec))
         out.append(rec)
-        if isinstance(rec, tracer.PyFuncRecord):
+        if isinstance(rec, (tracer.PyFuncRecord, tracer.PyLayerRecord)):
             ins = rec.inputs_list
         else:
             ins = [t for ts in rec.inputs.values() for t in ts]
@@ -69,6 +69,34 @@ def _run_record_backward(
 ):
     """Compute input grads for one tape node and accumulate."""
     from .tensor import Tensor
+
+    if isinstance(rec, tracer.PyLayerRecord):
+        # user-defined backward (autograd/py_layer.py parity): output grads
+        # in, input grads out; taped when create_graph for double-grad
+        cts = []
+        for t in rec.outputs_list:
+            g = _get_grad(grad_map, t)
+            if g is None:
+                g = jnp.zeros(t._array.shape, t._array.dtype)
+            if not isinstance(g, Tensor):
+                g = Tensor(g, stop_gradient=not create_graph)
+            cts.append(g)
+        old_grad = tracer.set_grad_enabled(create_graph)
+        try:
+            grads = rec.cls.backward(rec.ctx, *cts)
+        finally:
+            tracer.set_grad_enabled(old_grad)
+        if not isinstance(grads, (list, tuple)):
+            grads = [grads]
+        if len(grads) != len(rec.inputs_list):
+            raise ValueError(
+                f"{rec.cls.__name__}.backward returned {len(grads)} gradients "
+                f"for {len(rec.inputs_list)} tensor inputs")
+        for t, g in zip(rec.inputs_list, grads):
+            if g is None or t.stop_gradient or id(t) in no_grad_ids:
+                continue
+            _accum(grad_map, t, g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True))
+        return
 
     if isinstance(rec, tracer.PyFuncRecord):
         outs = rec.outputs_list
@@ -237,7 +265,7 @@ def run_backward(
     for rec in records:
         ins = (
             rec.inputs_list
-            if isinstance(rec, tracer.PyFuncRecord)
+            if isinstance(rec, (tracer.PyFuncRecord, tracer.PyLayerRecord))
             else [t for ts in rec.inputs.values() for t in ts]
         )
         for t in ins:
@@ -272,7 +300,7 @@ def run_backward(
 
 
 def _release(rec):
-    if isinstance(rec, tracer.PyFuncRecord):
+    if isinstance(rec, (tracer.PyFuncRecord, tracer.PyLayerRecord)):
         for t in rec.outputs_list:
             t.grad_node = None
         rec.inputs_list = []

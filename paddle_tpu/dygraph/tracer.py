@@ -274,6 +274,25 @@ def _trace_fn_static(fn, tensors, name):
     return res[0] if len(res) == 1 else res
 
 
+class PyLayerRecord:
+    """Tape node for user-defined PyLayer forward/backward pairs
+    (parity: imperative/py_layer_fwd.h + autograd/py_layer.py:1).  Shares the
+    PyFuncRecord interface (inputs_list/outputs_list) so collection/release
+    logic applies; backward calls the user's staticmethod instead of vjp."""
+
+    __slots__ = ("seq", "cls", "ctx", "inputs_list", "outputs_list",
+                 "in_arrays", "__weakref__")
+
+    def __init__(self, cls, ctx, inputs_list, outputs_list):
+        GradRecord._counter[0] += 1
+        self.seq = GradRecord._counter[0]
+        self.cls = cls
+        self.ctx = ctx
+        self.inputs_list = inputs_list
+        self.outputs_list = outputs_list
+        self.in_arrays = [t._array for t in inputs_list]
+
+
 class PyFuncRecord:
     """Tape node for trace_fn closures (PyLayer-style custom autograd).
     ``in_arrays`` snapshots input values at trace time (see GradRecord.snap)."""
