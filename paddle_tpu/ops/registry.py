@@ -99,15 +99,30 @@ def register_op(
     return deco
 
 
+import weakref
+
+# process-local one-off ops (trace_fn closures, control-flow sub-blocks):
+# weakly held so they die with the Operator/Program that owns them instead of
+# leaking per program build — owners keep a strong ref on the Operator
+_EPHEMERAL: "weakref.WeakValueDictionary[str, OpDef]" = weakref.WeakValueDictionary()
+
+
+def register_ephemeral(op_def: "OpDef") -> "OpDef":
+    _EPHEMERAL[op_def.type] = op_def
+    return op_def
+
+
 def get_op_def(type: str) -> OpDef:
     od = _REGISTRY.get(type)
+    if od is None:
+        od = _EPHEMERAL.get(type)
     if od is None:
         raise OpNotRegistered(f"Op {type!r} is not registered")
     return od
 
 
 def is_registered(type: str) -> bool:
-    return type in _REGISTRY
+    return type in _REGISTRY or type in _EPHEMERAL
 
 
 def all_ops() -> List[str]:

@@ -15,3 +15,5 @@ from .backward import append_backward, gradients  # noqa: F401
 from .executor import CompiledProgram, Executor  # noqa: F401
 from .io import load, load_inference_model, save, save_inference_model  # noqa: F401
 from .input import data, InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+from .control_flow import cond, while_loop  # noqa: F401

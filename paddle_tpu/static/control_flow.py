@@ -1,0 +1,265 @@
+"""Control-flow ops: ``while_loop`` and ``cond``.
+
+Parity: ``/root/reference/paddle/fluid/operators/controlflow/while_op.cc:1``,
+``conditional_block_op.cc:1`` and their surface
+``python/paddle/fluid/layers/control_flow.py`` (while_loop, cond).
+
+TPU-first design:
+  * dygraph mode = plain Python control flow over eager tensors (exactly the
+    reference's dygraph branch) — fully differentiable through the tape;
+  * static mode captures the branch/body as a sub-op-list (the reference's
+    sub-Block) and lowers it INTO the executor's single XLA program as
+    ``lax.cond`` / ``lax.while_loop`` via a one-off registered op;
+  * a trip-count inference pass (the role of XLA's own
+    ``WhileLoopTripCountAnnotator``) rewrites counted ``i < N`` loops to
+    ``lax.fori_loop`` with static bounds, which IS reverse-differentiable —
+    so RNN-style counted training loops get gradients, while genuinely
+    dynamic loops stay forward-only (reverse-mode through an unbounded while
+    is impossible under static memory; the reference pays for it with an
+    unbounded activation stack).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import program as fw
+from ..ops import registry
+
+__all__ = ["while_loop", "cond"]
+
+_cf_counter = [0]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _capture(fn, args):
+    """Run a branch/body builder under the current block and pop the ops it
+    appended (the sub-Block of while_op/conditional_block_op)."""
+    block = fw.default_main_program().current_block()
+    start = len(block.ops)
+    outs = fn(*args)
+    ops = list(block.ops[start:])
+    del block.ops[start:]
+    return _as_list(outs), outs if isinstance(outs, (list, tuple)) or outs is None else outs, ops
+
+
+def _externals(op_lists, exclude):
+    """Names read by the captured ops but produced outside them."""
+    produced = set(exclude)
+    ext: List[str] = []
+    for ops in op_lists:
+        inner = set()
+        for op in ops:
+            for n in op.input_arg_names:
+                if n and n not in produced and n not in inner and n not in ext:
+                    ext.append(n)
+            for n in op.output_arg_names:
+                if n:
+                    inner.add(n)
+        produced |= inner
+    return ext
+
+
+def _run_ops(ops, env):
+    """Interpret captured ops on an array env (the executor's inner loop)."""
+    for op in ops:
+        op_def = registry.get_op_def(op.type)
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = [env[n] for n in names if n]
+            if vals or slot in op_def.list_slots:
+                ins[slot] = vals
+        outs = registry.run_kernel(op_def, ins, op.attrs, rng=None)
+        for slot, names in op.outputs.items():
+            for n, v in zip(names, outs.get(slot, [])):
+                if n:
+                    env[n] = v
+    return env
+
+
+def _const_value(name, blocks):
+    for block in blocks:
+        for op in block.ops:
+            if op.type == "fill_constant" and name in op.output_arg_names:
+                return float(op.attrs.get("value"))
+    return None
+
+
+def _infer_trip_count(cond_ops, cond_out_name, body_ops, body_out_names,
+                      loop_names):
+    """Static trip count for the canonical counted loop
+    ``i = fill_constant(v0); while less_than(i, fill_constant(N)): i = i + c``."""
+    producer = {n: op for op in cond_ops for n in op.output_arg_names}
+    last = producer.get(cond_out_name)
+    if last is None or last.type != "less_than":
+        return None
+    x = (last.inputs.get("X") or [None])[0]
+    y = (last.inputs.get("Y") or [None])[0]
+    if x not in loop_names:
+        return None
+    blocks = [fw.default_main_program().global_block(),
+              fw.default_startup_program().global_block()]
+    bound = _const_value(y, blocks)
+    init = _const_value(x, blocks)
+    if bound is None or init is None:
+        return None
+    idx = loop_names.index(x)
+    out_name = body_out_names[idx]
+    step = None
+    for op in body_ops:
+        if out_name in op.output_arg_names:
+            if op.type == "scale" and (op.inputs.get("X") or [None])[0] == x:
+                if float(op.attrs.get("scale", 1.0)) == 1.0:
+                    step = float(op.attrs.get("bias", 0.0))
+            break
+    if not step or step <= 0:
+        return None
+    trips = math.ceil((bound - init) / step)
+    return max(int(trips), 0)
+
+
+def _register_one_off(op_type, kernel, no_grad=False):
+    registry._REGISTRY[op_type] = registry.OpDef(
+        type=op_type, kernel=kernel, list_slots={"X", "Captured", "Out"},
+        no_grad=no_grad,
+    )
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None):
+    """``paddle.static.nn.while_loop`` parity (control_flow.py while_loop)."""
+    loop_vars = _as_list(loop_vars)
+    if not loop_vars:
+        raise ValueError("loop_vars must not be empty")
+
+    if fw.in_dygraph_mode():
+        pred = cond(*loop_vars)
+        while bool(np.asarray(pred._array).reshape(-1)[0]):
+            out = _as_list(body(*loop_vars))
+            if len(out) != len(loop_vars):
+                raise ValueError(
+                    f"body returned {len(out)} vars, expected {len(loop_vars)}")
+            loop_vars = out
+            pred = cond(*loop_vars)
+        return loop_vars
+
+    from ..ops.dispatch import dispatch_static
+
+    block = fw.default_main_program().current_block()
+    cond_outs, _, cond_ops = _capture(cond, loop_vars)
+    body_outs, _, body_ops = _capture(body, loop_vars)
+    if len(body_outs) != len(loop_vars):
+        raise ValueError(
+            f"body returned {len(body_outs)} vars, expected {len(loop_vars)}")
+    loop_names = [v.name for v in loop_vars]
+    body_out_names = [v.name for v in body_outs]
+    cond_out_name = cond_outs[0].name
+    ext_names = _externals([cond_ops, body_ops], set(loop_names))
+    ext_vars = [block._var_recursive(n) for n in ext_names]
+
+    trip = None if is_test else _infer_trip_count(
+        cond_ops, cond_out_name, body_ops, body_out_names, loop_names)
+
+    n_loop = len(loop_vars)
+
+    def kernel(kins, attrs):
+        import jax.numpy as jnp
+        from jax import lax
+
+        xs = tuple(kins["X"])
+        exts = dict(zip(ext_names, kins.get("Captured", [])))
+
+        def run_body(carry):
+            env = dict(exts)
+            env.update(zip(loop_names, carry))
+            env = _run_ops(body_ops, env)
+            return tuple(env[n] for n in body_out_names)
+
+        def run_cond(carry):
+            env = dict(exts)
+            env.update(zip(loop_names, carry))
+            env = _run_ops(cond_ops, env)
+            return jnp.reshape(env[cond_out_name], ())
+
+        if trip is not None:
+            # counted loop -> fori with static bounds (reverse-differentiable)
+            out = lax.fori_loop(0, trip, lambda i, c: run_body(c), xs)
+        else:
+            out = lax.while_loop(run_cond, run_body, xs)
+        return {"Out": list(out)}
+
+    _cf_counter[0] += 1
+    op_type = f"__while_{_cf_counter[0]}"
+    # dynamic while cannot be reverse-differentiated — mark no_grad so
+    # append_backward raises a clear error instead of a jax internal one
+    _register_one_off(op_type, kernel, no_grad=(trip is None))
+    outs = dispatch_static(
+        op_type,
+        {"X": loop_vars, "Captured": ext_vars},
+        {"trip_count": -1 if trip is None else trip},
+    )["Out"]
+    return outs[:n_loop]
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None):
+    """``paddle.static.nn.cond`` parity (conditional_block_op role)."""
+    if fw.in_dygraph_mode():
+        taken = bool(np.asarray(pred._array).reshape(-1)[0])
+        fn = true_fn if taken else false_fn
+        return fn() if fn is not None else None
+
+    from ..ops.dispatch import dispatch_static
+
+    block = fw.default_main_program().current_block()
+    true_outs, _, true_ops = _capture(true_fn, ()) if true_fn else ([], None, [])
+    false_outs, _, false_ops = _capture(false_fn, ()) if false_fn else ([], None, [])
+    if len(true_outs) != len(false_outs):
+        raise ValueError(
+            f"true_fn returned {len(true_outs)} vars but false_fn returned "
+            f"{len(false_outs)} — branch outputs must match")
+    if not true_outs:
+        return None
+    t_names = [v.name for v in true_outs]
+    f_names = [v.name for v in false_outs]
+    ext_names = _externals([true_ops, false_ops], set())
+    ext_vars = [block._var_recursive(n) for n in ext_names]
+    single = len(true_outs) == 1
+
+    def kernel(kins, attrs):
+        import jax.numpy as jnp
+        from jax import lax
+
+        p = jnp.reshape(kins["Cond"][0], ())
+        exts = tuple(kins.get("Captured", []))
+
+        def tbr(ext_t):
+            env = _run_ops(true_ops, dict(zip(ext_names, ext_t)))
+            return tuple(env[n] for n in t_names)
+
+        def fbr(ext_t):
+            env = _run_ops(false_ops, dict(zip(ext_names, ext_t)))
+            return tuple(env[n] for n in f_names)
+
+        out = lax.cond(p, tbr, fbr, exts)
+        return {"Out": list(out)}
+
+    _cf_counter[0] += 1
+    op_type = f"__cond_{_cf_counter[0]}"
+    registry._REGISTRY[op_type] = registry.OpDef(
+        type=op_type, kernel=kernel,
+        list_slots={"Cond", "Captured", "Out"}, nondiff_slots=("Cond",),
+    )
+    outs = dispatch_static(
+        op_type, {"Cond": [pred], "Captured": ext_vars}, {})["Out"]
+    return outs[0] if single else outs

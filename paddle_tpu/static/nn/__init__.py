@@ -1,0 +1,26 @@
+"""``paddle.static.nn`` — static-graph layer builders + control flow.
+
+Parity: ``/root/reference/python/paddle/static/nn/__init__.py`` (fc, control
+flow re-exports from fluid.layers).
+"""
+
+from __future__ import annotations
+
+from ..control_flow import cond, while_loop  # noqa: F401
+
+__all__ = ["while_loop", "cond", "fc"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """``paddle.static.nn.fc`` (fluid.layers.fc role): y = act(x W + b)."""
+    from ... import nn as _nn
+    from ...nn import functional as F
+    import numpy as np
+
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = _nn.Linear(in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out

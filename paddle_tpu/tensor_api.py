@@ -42,7 +42,7 @@ __all__ = [
     "nonzero", "roll", "flip", "tril", "triu", "unique", "topk", "argmax",
     "argmin", "argsort", "sort", "cast", "slice", "strided_slice",
     "take_along_axis", "broadcast_to", "meshgrid", "norm", "dist", "kron",
-    "flops", "increment", "is_tensor", "shape", "real",
+    "flops", "increment", "is_tensor", "shape", "real", "create_parameter",
     "multiplex", "histogram", "bincount", "cross", "diag", "mv",
 ]
 
@@ -360,6 +360,22 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 def increment(x, value=1.0, name=None):
     return scale(x, 1.0, value)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """``paddle.create_parameter`` (tensor/creation.py role): a trainable
+    parameter usable in both modes (static: main-program Parameter + startup
+    init op, the LayerHelper.create_parameter path)."""
+    from .nn.layer_base import Layer
+    from .nn import ParamAttr
+
+    helper = Layer()
+    if name is not None:
+        attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(
+        shape, attr=attr, dtype=dtype, is_bias=is_bias,
+        default_initializer=default_initializer)
 
 
 def cumsum(x, axis=None, dtype=None, name=None):

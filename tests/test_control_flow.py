@@ -1,0 +1,197 @@
+"""Control-flow op tests: while_loop + cond, both modes, incl. gradients
+through a counted static loop (while_op.cc / conditional_block_op.cc parity,
+SURVEY.md §7 layer-2 op set).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+# -- dygraph ---------------------------------------------------------------
+
+def test_while_loop_dygraph():
+    i = paddle.full([1], 0, "int64")
+    ten = paddle.full([1], 10, "int64")
+    out = paddle.static.nn.while_loop(
+        lambda i: paddle.less_than(i, ten), lambda i: i + 1, [i])
+    assert int(out[0].numpy()[0]) == 10
+
+
+def test_while_loop_dygraph_grad():
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    i = paddle.full([1], 0, "int64")
+    three = paddle.full([1], 3, "int64")
+
+    def body(i, acc):
+        return i + 1, acc * x
+
+    one = paddle.full([1], 1.0, "float32")
+    one.stop_gradient = False
+    i_out, acc = paddle.static.nn.while_loop(
+        lambda i, acc: paddle.less_than(i, three), body, [i, one])
+    acc.backward()
+    np.testing.assert_allclose(acc.numpy(), [8.0])
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])  # d(x^3)/dx = 3x^2
+
+
+def test_cond_dygraph():
+    a = paddle.to_tensor(np.array([3.0], "float32"))
+    b = paddle.to_tensor(np.array([5.0], "float32"))
+    out = paddle.static.nn.cond(paddle.less_than(a, b),
+                                lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.numpy(), [8.0])
+    out = paddle.static.nn.cond(paddle.less_than(b, a),
+                                lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.numpy(), [-2.0])
+
+
+# -- static ----------------------------------------------------------------
+
+def test_while_loop_static_counted(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        i = paddle.full([1], 0, "int64")
+        ten = paddle.full([1], 10, "int64")
+        acc = paddle.full([1], 1.0, "float32")
+
+        def body(i, acc):
+            return paddle.increment(i, 1), acc * 2.0
+
+        i_out, acc_out = static.nn.while_loop(
+            lambda i, acc: paddle.less_than(i, ten), body, [i, acc])
+    exe = static.Executor()
+    exe.run(startup)
+    iv, av = exe.run(main, fetch_list=[i_out, acc_out])
+    assert int(iv[0]) == 10
+    np.testing.assert_allclose(av, [1024.0])
+
+
+def test_while_loop_static_grad_rnn_style(static_mode):
+    """A counted loop through a weight must train (append_backward works via
+    the fori lowering)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        w = paddle.create_parameter([8, 8], "float32")
+        i = paddle.full([1], 0, "int64")
+        steps = paddle.full([1], 3, "int64")
+
+        def body(i, h):
+            return paddle.increment(i, 1), paddle.tanh(paddle.matmul(h, w))
+
+        _, h_out = static.nn.while_loop(
+            lambda i, h: paddle.less_than(i, steps), body, [i, x])
+        loss = paddle.mean(h_out)
+        grads = static.append_backward(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    (lv,) = exe.run(main, feed={"x": rng.randn(4, 8).astype("float32")},
+                    fetch_list=[loss])
+    assert np.isfinite(lv).all()
+    gnames = [g.name for _, g in grads]
+    vals = exe.run(main, feed={"x": rng.randn(4, 8).astype("float32")},
+                   fetch_list=gnames)
+    for v in vals:
+        assert np.isfinite(np.asarray(v)).all()
+        assert np.abs(np.asarray(v)).sum() > 0  # grads actually flow
+
+
+def test_while_loop_static_trains(static_mode):
+    """End-to-end: SGD through a counted loop reduces the loss."""
+    import paddle_tpu.optimizer as opt
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [16, 4], "float32")
+        y = static.data("y", [16, 1], "float32")
+        w = paddle.create_parameter([4, 4], "float32")
+        w2 = paddle.create_parameter([4, 1], "float32")
+        i = paddle.full([1], 0, "int64")
+        steps = paddle.full([1], 2, "int64")
+
+        def body(i, h):
+            return paddle.increment(i, 1), paddle.tanh(paddle.matmul(h, w))
+
+        _, h = static.nn.while_loop(
+            lambda i, h: paddle.less_than(i, steps), body, [i, x])
+        pred = paddle.matmul(h, w2)
+        loss = paddle.mean(paddle.square(pred - y))
+        opt.SGD(0.1).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    xb = rng.randn(16, 4).astype("float32")
+    yb = (xb @ rng.randn(4, 1)).astype("float32")
+    losses = [float(np.asarray(exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])[0]))
+              for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_while_loop_static_dynamic_cond(static_mode):
+    """A value-dependent (uncounted) loop still runs via lax.while_loop."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        v = static.data("v", [1], "float32")
+        limit = paddle.full([1], 100.0, "float32")
+
+        def body(v):
+            return v * 2.0
+
+        (v_out,) = static.nn.while_loop(
+            lambda v: paddle.less_than(v, limit), body, [v])
+    exe = static.Executor()
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"v": np.array([3.0], "float32")},
+                     fetch_list=[v_out])
+    assert float(out[0]) == 192.0  # 3 -> 6 -> ... -> 192 >= 100
+
+
+def test_cond_static(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        a = static.data("a", [1], "float32")
+        b = static.data("b", [1], "float32")
+        out = static.nn.cond(paddle.less_than(a, b),
+                             lambda: a + b, lambda: a - b)
+    exe = static.Executor()
+    exe.run(startup)
+    (r,) = exe.run(main, feed={"a": np.array([3.0], "float32"),
+                               "b": np.array([5.0], "float32")},
+                   fetch_list=[out])
+    np.testing.assert_allclose(r, [8.0])
+    (r,) = exe.run(main, feed={"a": np.array([7.0], "float32"),
+                               "b": np.array([5.0], "float32")},
+                   fetch_list=[out])
+    np.testing.assert_allclose(r, [2.0])
+
+
+def test_cond_static_grad(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2], "float32")
+        x.stop_gradient = False
+        flag = static.data("flag", [1], "bool")
+        out = static.nn.cond(flag, lambda: paddle.sum(x * 3.0),
+                             lambda: paddle.sum(x * 5.0))
+        grads = static.gradients([out], [x])
+    exe = static.Executor()
+    exe.run(startup)
+    (g,) = exe.run(main, feed={"x": np.ones(2, "float32"),
+                               "flag": np.array([True])},
+                   fetch_list=[grads[0]])
+    np.testing.assert_allclose(g, [3.0, 3.0])
+    (g,) = exe.run(main, feed={"x": np.ones(2, "float32"),
+                               "flag": np.array([False])},
+                   fetch_list=[grads[0]])
+    np.testing.assert_allclose(g, [5.0, 5.0])
