@@ -194,7 +194,15 @@ class Tensor:
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._array
-        # full overwrite: no gradient flows through the old value — detach
+        # Full overwrite: no gradient flows INTO the old value, but consumers
+        # that already read the old value must keep their tape intact — a
+        # mutated non-leaf intermediate would otherwise silently mis-
+        # differentiate (producers get no grad, the intermediate a bogus one;
+        # the reference catches this with inplace version counters).
+        if tracer.has_grad() and self.grad_node is not None:
+            varr = jnp.asarray(value, self._array.dtype).reshape(self._array.shape)
+            self._taped_inplace(lambda a: varr, [], name="set_value")
+            return
         self.grad_node = None
         self._array = jnp.asarray(value, self._array.dtype).reshape(self._array.shape)
 
@@ -203,11 +211,16 @@ class Tensor:
         return self
 
     def fill_(self, value):
+        if tracer.has_grad() and self.grad_node is not None:
+            return self._taped_inplace(
+                lambda a: jnp.full_like(a, value), [], name="fill_")
         self.grad_node = None
         self._array = jnp.full_like(self._array, value)
         return self
 
     def zero_(self):
+        if tracer.has_grad() and self.grad_node is not None:
+            return self._taped_inplace(jnp.zeros_like, [], name="zero_")
         self.grad_node = None
         self._array = jnp.zeros_like(self._array)
         return self

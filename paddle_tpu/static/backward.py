@@ -85,14 +85,26 @@ def append_backward(
         if not op_def_known:
             continue
         op_def = registry.get_op_def(op.type)
-        if op_def.no_grad:
-            continue
         out_grad_names = [
             grad_var_name(n)
             for slot, names in op.outputs.items()
             if slot not in op_def.nondiff_out_slots
             for n in names
         ]
+        if op_def.no_grad:
+            # a dynamic while_loop on the grad path fails loudly WITH the
+            # trip-count inference diagnosis instead of silently zeroing
+            reason = op.attrs.get("__no_fori_reason__")
+            if reason is not None and any(
+                    g in produced_grads for g in out_grad_names):
+                raise RuntimeError(
+                    f"append_backward: op {op.type!r} is a dynamic "
+                    f"lax.while_loop, which cannot be reverse-differentiated "
+                    f"under static memory. Trip-count inference failed "
+                    f"because: {reason}. Rewrite the loop as a counted "
+                    f"``i < N`` loop with fill_constant bounds, or compute "
+                    f"the loss outside the loop.")
+            continue
         if not any(g in produced_grads for g in out_grad_names):
             continue
         # outputs with no incoming grad get explicit zeros (parity:

@@ -97,22 +97,42 @@ def _const_value(name, blocks):
 
 def _infer_trip_count(cond_ops, cond_out_name, body_ops, body_out_names,
                       loop_names):
-    """Static trip count for the canonical counted loop
-    ``i = fill_constant(v0); while less_than(i, fill_constant(N)): i = i + c``."""
+    """Static trip count for counted loops.
+
+    Recognized forms (role of XLA's WhileLoopTripCountAnnotator):
+      cond:  ``less_than(i, N)`` / ``less_equal(i, N)`` with ``i`` a loop var
+             and both ``i``'s init and ``N`` produced by ``fill_constant``;
+      body:  ``i = scale(i, scale=1, bias=step)`` or
+             ``i = elementwise_add(i, fill_constant(step))`` with step > 0.
+
+    Returns ``(trip_count, const_var_names, None)`` on success or
+    ``(None, [], reason)`` explaining why the loop stays dynamic — the reason
+    is surfaced by ``append_backward`` when a gradient is requested.
+    """
     producer = {n: op for op in cond_ops for n in op.output_arg_names}
     last = producer.get(cond_out_name)
-    if last is None or last.type != "less_than":
-        return None
+    if last is None:
+        return None, [], "loop condition is not produced inside cond_fn"
+    if last.type not in ("less_than", "less_equal"):
+        return None, [], (
+            f"loop condition op is {last.type!r}; only less_than/less_equal "
+            f"comparisons against a constant bound are recognized as counted")
+    inclusive = last.type == "less_equal"
     x = (last.inputs.get("X") or [None])[0]
     y = (last.inputs.get("Y") or [None])[0]
     if x not in loop_names:
-        return None
+        return None, [], (
+            f"comparison LHS {x!r} is not a loop variable — the counter must "
+            f"be one of loop_vars")
     blocks = [fw.default_main_program().global_block(),
               fw.default_startup_program().global_block()]
     bound = _const_value(y, blocks)
     init = _const_value(x, blocks)
     if bound is None or init is None:
-        return None
+        missing = y if bound is None else x
+        return None, [], (
+            f"{missing!r} is not a fill_constant — counter init and bound "
+            f"must be compile-time constants for a static trip count")
     idx = loop_names.index(x)
     out_name = body_out_names[idx]
     step = None
@@ -121,18 +141,41 @@ def _infer_trip_count(cond_ops, cond_out_name, body_ops, body_out_names,
             if op.type == "scale" and (op.inputs.get("X") or [None])[0] == x:
                 if float(op.attrs.get("scale", 1.0)) == 1.0:
                     step = float(op.attrs.get("bias", 0.0))
+            elif op.type in ("elementwise_add", "elementwise_sub"):
+                a = (op.inputs.get("X") or [None])[0]
+                b = (op.inputs.get("Y") or [None])[0]
+                other = b if a == x else (a if b == x else None)
+                if other is not None:
+                    c = _const_value(other, blocks + [_FakeBlock(body_ops)])
+                    if c is not None:
+                        step = -c if op.type == "elementwise_sub" else c
             break
-    if not step or step <= 0:
-        return None
-    trips = math.ceil((bound - init) / step)
-    return max(int(trips), 0)
+    if step is None:
+        return None, [], (
+            f"counter update for {x!r} is not ``scale(bias=step)`` or "
+            f"``elementwise_add(i, const)`` — cannot derive a static step")
+    if step <= 0:
+        return None, [], f"counter step {step} is not positive"
+    trips = math.ceil((bound + (1 if inclusive else 0) - init) / step)
+    return max(int(trips), 0), [x, y], None
 
 
-def _register_one_off(op_type, kernel, no_grad=False):
-    registry._REGISTRY[op_type] = registry.OpDef(
-        type=op_type, kernel=kernel, list_slots={"X", "Captured", "Out"},
-        no_grad=no_grad,
-    )
+class _FakeBlock:
+    """Adapter so _const_value can also scan body ops for constants."""
+
+    def __init__(self, ops):
+        self.ops = ops
+
+
+def _register_one_off(op_type, kernel, no_grad=False, **kw):
+    """Ephemeral registration: the OpDef dies with the owning Operator, which
+    must keep a strong ref via ``op._ephemeral_def`` (registry weak-holds it).
+    Fixes the per-program-build permanent-registry leak (ADVICE round 2)."""
+    return registry.register_ephemeral(registry.OpDef(
+        type=op_type, kernel=kernel,
+        list_slots=kw.pop("list_slots", {"X", "Captured", "Out"}),
+        no_grad=no_grad, **kw,
+    ))
 
 
 def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
@@ -167,8 +210,11 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
     ext_names = _externals([cond_ops, body_ops], set(loop_names))
     ext_vars = [block._var_recursive(n) for n in ext_names]
 
-    trip = None if is_test else _infer_trip_count(
-        cond_ops, cond_out_name, body_ops, body_out_names, loop_names)
+    if is_test:
+        trip, const_vars, why = None, [], "is_test=True loops stay dynamic"
+    else:
+        trip, const_vars, why = _infer_trip_count(
+            cond_ops, cond_out_name, body_ops, body_out_names, loop_names)
 
     n_loop = len(loop_vars)
 
@@ -201,13 +247,21 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
     _cf_counter[0] += 1
     op_type = f"__while_{_cf_counter[0]}"
     # dynamic while cannot be reverse-differentiated — mark no_grad so
-    # append_backward raises a clear error instead of a jax internal one
-    _register_one_off(op_type, kernel, no_grad=(trip is None))
+    # append_backward raises a clear error (carrying ``why``) instead of a
+    # jax internal one
+    od = _register_one_off(op_type, kernel, no_grad=(trip is None))
+    attrs = {"trip_count": -1 if trip is None else trip}
+    if trip is None:
+        attrs["__no_fori_reason__"] = why
+    else:
+        # the fori rewrite baked these fill_constant values in; feeding them
+        # at run time would be silently ignored — the executor rejects that
+        # (ADVICE round 2)
+        attrs["__trip_const_vars__"] = list(const_vars)
     outs = dispatch_static(
-        op_type,
-        {"X": loop_vars, "Captured": ext_vars},
-        {"trip_count": -1 if trip is None else trip},
+        op_type, {"X": loop_vars, "Captured": ext_vars}, attrs,
     )["Out"]
+    block.ops[-1]._ephemeral_def = od
     return outs[:n_loop]
 
 
@@ -256,10 +310,11 @@ def cond(pred, true_fn: Optional[Callable] = None,
 
     _cf_counter[0] += 1
     op_type = f"__cond_{_cf_counter[0]}"
-    registry._REGISTRY[op_type] = registry.OpDef(
-        type=op_type, kernel=kernel,
-        list_slots={"Cond", "Captured", "Out"}, nondiff_slots=("Cond",),
+    od = _register_one_off(
+        op_type, kernel, list_slots={"Cond", "Captured", "Out"},
+        nondiff_slots={"Cond"},
     )
     outs = dispatch_static(
         op_type, {"Cond": [pred], "Captured": ext_vars}, {})["Out"]
+    block.ops[-1]._ephemeral_def = od
     return outs[0] if single else outs

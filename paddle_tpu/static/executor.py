@@ -127,6 +127,21 @@ class Executor:
         ops = [op for op in block.ops if op.type not in _SKIP_OPS]
         feed_names = set(feed)
 
+        # counted while_loops were rewritten to fixed-trip fori_loops using
+        # fill_constant values read at build time; feeding those vars would be
+        # silently ignored — reject instead (ADVICE round 2)
+        for op in ops:
+            baked = op.attrs.get("__trip_const_vars__")
+            if baked:
+                clash = feed_names.intersection(baked)
+                if clash:
+                    raise ValueError(
+                        f"Executor.run: feed overrides {sorted(clash)}, but "
+                        f"op {op.type!r} statically baked those fill_constant "
+                        f"values into its loop trip count at build time. "
+                        f"Build the loop bound from a data tensor (not a "
+                        f"fed constant), or rebuild the program per bound.")
+
         # classify vars: state-in = persistable inputs not fed; everything an
         # op produces that is persistable goes back to the scope.
         produced = set()
