@@ -131,6 +131,14 @@ def _to_array(v):
     return np.asarray(v)
 
 
+def _prof_active() -> bool:
+    """True when paddle_tpu.profiler is collecting op-level host events."""
+    import sys
+
+    prof = sys.modules.get("paddle_tpu.profiler")
+    return prof is not None and prof.is_profiling()
+
+
 def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any]):
     """Run one op eagerly; returns slot -> list[Tensor]."""
     from .tensor import Tensor
@@ -167,7 +175,22 @@ def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any]):
 
         rng = next_rng_key()
 
-    outs = run_eager_kernel(op_type, ins_arrays, attrs, rng=rng)
+    from ..framework import flags
+
+    if flags.flag("FLAGS_benchmark") or _prof_active():
+        from ..profiler import RecordEvent
+
+        with RecordEvent(op_type):
+            outs = run_eager_kernel(op_type, ins_arrays, attrs, rng=rng)
+            if flags.flag("FLAGS_benchmark"):
+                jax.block_until_ready(outs)
+    else:
+        outs = run_eager_kernel(op_type, ins_arrays, attrs, rng=rng)
+
+    if flags.flag("FLAGS_check_nan_inf"):
+        from ..framework.nan_inf import assert_all_finite_eager
+
+        assert_all_finite_eager(op_type, outs)
 
     requires_grad = (
         has_grad()

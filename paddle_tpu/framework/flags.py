@@ -1,0 +1,166 @@
+"""FLAGS registry: env-bootstrapped global configuration.
+
+Role parity: the reference's three-tier gflags system —
+``/root/reference/paddle/fluid/platform/flags.cc:44`` (C++ DEFINE_bool
+``check_nan_inf`` et al), the pybind getter/setter bridge
+(``pybind/global_value_getter_setter.cc``) and the env bootstrap in
+``/root/reference/python/paddle/fluid/__init__.py:147`` (``__bootstrap__``
+whitelists ``read_env_flags`` and forwards ``FLAGS_*`` env vars).
+
+TPU-native reading: most reference flags tune subsystems XLA owns outright
+(allocator strategy, GC thresholds, cudnn autotune).  Those names are still
+*accepted* — scripts that set them keep working — but marked inert.  Flags
+that do steer this runtime (nan/inf checking, benchmark sync, matmul
+precision, flash-attention gating, profiler dir) are live and read at use
+sites via :func:`flag`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Sequence, Union
+
+
+class _FlagDef:
+    __slots__ = ("name", "type", "default", "help", "writable", "inert", "on_set")
+
+    def __init__(self, name, type_, default, help_="", writable=True,
+                 inert=False, on_set=None):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+        self.writable = writable
+        self.inert = inert
+        self.on_set = on_set
+
+
+_DEFS: Dict[str, _FlagDef] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def _parse(defn: _FlagDef, raw: Any) -> Any:
+    if defn.type is bool:
+        if isinstance(raw, str):
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return defn.type(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "", *, type: type = None,
+                writable: bool = True, inert: bool = False, on_set=None) -> None:
+    """Register a flag (and bootstrap its value from the environment)."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    defn = _FlagDef(name, type if type is not None else default.__class__,
+                    default, help, writable, inert, on_set)
+    _DEFS[name] = defn
+    env = os.environ.get(name)
+    value = _parse(defn, env) if env is not None else default
+    _VALUES[name] = value
+    if defn.on_set is not None and env is not None:
+        defn.on_set(value)
+
+
+def flag(name: str) -> Any:
+    """Fast internal getter (no validation; KeyError on unknown flag)."""
+    return _VALUES[name]
+
+
+def get_flags(flags: Union[str, Sequence[str]]) -> Dict[str, Any]:
+    """``paddle.get_flags`` parity: value lookup for one or many flags."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for n in flags:
+        if n not in _VALUES:
+            raise ValueError(f"Flag {n!r} is not registered "
+                             f"(known: {len(_VALUES)} FLAGS_* names)")
+        out[n] = _VALUES[n]
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """``paddle.set_flags`` parity: update writable flags."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags expects a dict of {flag_name: value}")
+    for n, v in flags.items():
+        defn = _DEFS.get(n)
+        if defn is None:
+            raise ValueError(f"Flag {n!r} is not registered")
+        if not defn.writable:
+            raise ValueError(f"Flag {n!r} is not public/writable")
+        val = _parse(defn, v)
+        _VALUES[n] = val
+        if defn.on_set is not None:
+            defn.on_set(val)
+
+
+def all_flags() -> List[str]:
+    return sorted(_DEFS)
+
+
+def is_inert(name: str) -> bool:
+    return _DEFS[name].inert
+
+
+# ---------------------------------------------------------------------------
+# flag definitions
+# ---------------------------------------------------------------------------
+
+def _set_matmul_precision(v: str) -> None:
+    import jax
+
+    if v:
+        jax.config.update("jax_default_matmul_precision", v)
+
+
+# live flags (read at use sites)
+define_flag("FLAGS_check_nan_inf", False,
+            "check every op output for NaN/Inf and raise naming the op "
+            "(ref flags.cc:44; framework/details/nan_inf_utils_detail.cc)")
+define_flag("FLAGS_benchmark", False,
+            "block on every eager op so profiler timings are real kernel "
+            "times, not async dispatch times (ref flags.cc benchmark)")
+define_flag("FLAGS_call_stack_level", 1,
+            "error verbosity: >=2 attaches the Python build stack to "
+            "executor errors (ref op_call_stack.cc role)")
+define_flag("FLAGS_tpu_flash_attention", True,
+            "allow nn.functional attention to route to the Pallas flash "
+            "kernel when geometry supports it (TPU-specific)")
+define_flag("FLAGS_tpu_matmul_precision", "",
+            "jax default_matmul_precision override: one of '', 'default', "
+            "'bfloat16', 'tensorfloat32', 'float32' (TPU-specific)",
+            type=str, on_set=_set_matmul_precision)
+define_flag("FLAGS_profiler_logdir", "/tmp/paddle_tpu_profile",
+            "TensorBoard trace directory used by paddle_tpu.profiler")
+define_flag("FLAGS_selected_tpus", "",
+            "comma list of visible device indices (role of "
+            "FLAGS_selected_gpus in launch_utils.py)", type=str)
+
+# accepted-but-inert reference flags: the subsystem they tune is owned by
+# XLA here (buffer assignment ≙ memory passes, async runtime ≙ executor
+# knobs).  Kept so reference scripts' set_flags calls don't break.
+for _name, _default in [
+    ("FLAGS_allocator_strategy", "auto_growth"),
+    ("FLAGS_eager_delete_tensor_gb", 0.0),
+    ("FLAGS_fast_eager_deletion_mode", True),
+    ("FLAGS_memory_fraction_of_eager_deletion", 1.0),
+    ("FLAGS_fraction_of_gpu_memory_to_use", 0.92),
+    ("FLAGS_initial_cpu_memory_in_mb", 500),
+    ("FLAGS_init_allocated_mem", False),
+    ("FLAGS_paddle_num_threads", 1),
+    ("FLAGS_inner_op_parallelism", 0),
+    ("FLAGS_cudnn_deterministic", False),
+    ("FLAGS_cudnn_exhaustive_search", False),
+    ("FLAGS_conv_workspace_size_limit", 512),
+    ("FLAGS_sync_nccl_allreduce", True),
+    ("FLAGS_fuse_parameter_groups_size", 3),
+    ("FLAGS_fuse_parameter_memory_size", -1.0),
+    ("FLAGS_check_kernel_launch", False),
+    ("FLAGS_max_inplace_grad_add", 0),
+    ("FLAGS_use_mkldnn", False),
+    ("FLAGS_use_ngraph", False),
+]:
+    define_flag(_name, _default, "accepted for script compatibility; the "
+                "underlying subsystem is owned by XLA on TPU", inert=True)

@@ -1,0 +1,59 @@
+"""NaN/Inf numerical sanitizer (``FLAGS_check_nan_inf``).
+
+Role parity: ``/root/reference/paddle/fluid/framework/details/
+nan_inf_utils_detail.{cc,cu}`` + the enforce hook at ``operator.cc:1040-1047``
+— with the flag set, every op's outputs are scanned and the first offending
+op aborts the run with its name.
+
+TPU-native shape: inside a jitted program we cannot raise from device code,
+so the static Executor threads a per-op ``all-finite`` bool vector out of the
+compiled step and raises host-side naming the first bad op; the eager tracer
+checks after each kernel (a host sync per op — debug-flag cost, exactly like
+the reference's device-to-host copy in CheckVarHasNanOrInf).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _float_arrays(outs):
+    for slot, vals in outs.items():
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for i, v in enumerate(vals):
+            if v is not None and jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact):
+                yield slot, i, v
+
+
+def op_all_finite(outs) -> jnp.ndarray:
+    """Traced scalar bool: every inexact output of this op is finite."""
+    ok = jnp.asarray(True)
+    for _, _, v in _float_arrays(outs):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v)))
+    return ok
+
+
+def assert_all_finite_eager(op_type: str, outs) -> None:
+    """Eager-mode check: host-syncs and raises on the first non-finite output."""
+    for slot, i, v in _float_arrays(outs):
+        a = np.asarray(v)
+        if not np.isfinite(a).all():
+            n_nan = int(np.isnan(a).sum())
+            n_inf = int(np.isinf(a).sum())
+            raise RuntimeError(
+                f"FLAGS_check_nan_inf: op {op_type!r} output "
+                f"{slot}[{i}] (shape {a.shape}, dtype {a.dtype}) contains "
+                f"{n_nan} NaN and {n_inf} Inf values")
+
+
+def raise_first_bad_op(ok_vector, op_labels) -> None:
+    """Host-side: raise naming the first op whose finite-check failed."""
+    oks = np.asarray(ok_vector)
+    if oks.all():
+        return
+    idx = int(np.argmin(oks))  # first False
+    raise RuntimeError(
+        f"FLAGS_check_nan_inf: op #{idx} {op_labels[idx]} produced NaN/Inf "
+        f"({int((~oks.astype(bool)).sum())} op(s) non-finite in this step)")

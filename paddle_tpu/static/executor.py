@@ -51,6 +51,13 @@ logger = logging.getLogger(__name__)
 _SKIP_OPS = frozenset({"feed", "fetch"})
 
 
+import contextlib
+
+
+def _null_ctx():
+    return contextlib.nullcontext()
+
+
 class Executor:
     """``paddle.static.Executor`` replacement (see module docstring)."""
 
@@ -86,13 +93,17 @@ class Executor:
             (name, tuple(np.shape(val)), str(np.asarray(val).dtype) if not hasattr(val, "dtype") else str(val.dtype))
             for name, val in sorted(feed.items())
         )
-        key = (id(program), program._version, feed_sig, tuple(fetch_names))
+        from ..framework import flags
+
+        check_nan = flags.flag("FLAGS_check_nan_inf")
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               check_nan)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, block, feed, fetch_names, scope)
             if use_program_cache:
                 self._cache[key] = entry
-        compiled, mut_names, const_names = entry
+        compiled, mut_names, const_names, op_labels = entry
 
         def load(names):
             st = {}
@@ -115,7 +126,20 @@ class Executor:
         seed = program.random_seed or 0
         rng = jax.random.fold_in(jax.random.PRNGKey(seed), step_id)
 
-        out_state, fetches = compiled(mut_state, const_state, feeds, rng)
+        import sys
+
+        prof = sys.modules.get("paddle_tpu.profiler")
+        ctx = (prof.RecordEvent("executor_run")
+               if prof is not None and prof.is_profiling() else _null_ctx())
+        with ctx:
+            if op_labels is None:
+                out_state, fetches = compiled(mut_state, const_state, feeds, rng)
+            else:
+                out_state, fetches, oks = compiled(
+                    mut_state, const_state, feeds, rng)
+                from ..framework.nan_inf import raise_first_bad_op
+
+                raise_first_bad_op(oks, op_labels)
         for n, v in out_state.items():
             scope.set(n, v)
         if return_numpy:
@@ -185,10 +209,23 @@ class Executor:
         mut_names = [n for n in state_in if n in out_set]
         const_names = [n for n in state_in if n not in out_set]
 
+        from ..framework import flags as _flags
+
+        check_nan = _flags.flag("FLAGS_check_nan_inf")
+        op_labels = None
+        if check_nan:
+            from ..framework import nan_inf
+
+            op_labels = [
+                f"{op.type}({', '.join(n for ns in op.outputs.values() for n in ns if n)})"
+                for op in ops
+            ]
+
         def step(mut_state: Dict[str, Any], const_state: Dict[str, Any], feeds, rng):
             env = dict(mut_state)
             env.update(const_state)
             env.update(feeds)
+            oks = []
             for i, op in enumerate(ops):
                 op_def = registry.get_op_def(op.type)
                 ins = {}
@@ -198,6 +235,8 @@ class Executor:
                         ins[slot] = vals
                 r = jax.random.fold_in(rng, i) if op_def.needs_rng else None
                 outs = registry.run_kernel(op_def, ins, op.attrs, rng=r)
+                if check_nan:
+                    oks.append(nan_inf.op_all_finite(outs))
                 for slot, names in op.outputs.items():
                     vals = outs.get(slot, [])
                     for n, v in zip(names, vals):
@@ -205,10 +244,15 @@ class Executor:
                             env[n] = v
             new_state = {n: env[n] for n in out_state if n in env}
             fetches = [env[n] for n in fetch_names]
+            if check_nan:
+                import jax.numpy as jnp
+
+                return new_state, fetches, (
+                    jnp.stack(oks) if oks else jnp.ones((0,), jnp.bool_))
             return new_state, fetches
 
         compiled = jax.jit(step, donate_argnums=(0,))
-        return compiled, mut_names, const_names
+        return compiled, mut_names, const_names, op_labels
 
     # ------------------------------------------------------------------
     def _to_device(self, val, block, name):

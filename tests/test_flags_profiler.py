@@ -1,0 +1,114 @@
+"""FLAGS registry, NaN/Inf sanitizer, and profiler tests.
+
+Parity targets: reference ``platform/flags.cc:44`` (FLAGS_check_nan_inf),
+``python/paddle/fluid/__init__.py:147`` (env bootstrap),
+``fluid/profiler.py:314`` (profiler context + report).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import flags
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    flags.set_flags({"FLAGS_check_nan_inf": False, "FLAGS_benchmark": False})
+
+
+def test_get_set_flags_roundtrip():
+    assert paddle.get_flags("FLAGS_check_nan_inf") == {
+        "FLAGS_check_nan_inf": False}
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags(["FLAGS_check_nan_inf"])[
+        "FLAGS_check_nan_inf"] is True
+    paddle.set_flags({"FLAGS_check_nan_inf": 0})
+    assert flags.flag("FLAGS_check_nan_inf") is False
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(ValueError):
+        paddle.get_flags("FLAGS_no_such_flag_xyz")
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_no_such_flag_xyz": 1})
+
+
+def test_inert_reference_flags_accepted():
+    # reference scripts set these; they must round-trip without error
+    paddle.set_flags({"FLAGS_eager_delete_tensor_gb": 1.5,
+                      "FLAGS_allocator_strategy": "naive_best_fit"})
+    got = paddle.get_flags(["FLAGS_eager_delete_tensor_gb",
+                            "FLAGS_allocator_strategy"])
+    assert got["FLAGS_eager_delete_tensor_gb"] == 1.5
+    assert got["FLAGS_allocator_strategy"] == "naive_best_fit"
+
+
+def test_check_nan_inf_eager():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    x = paddle.to_tensor(np.array([1.0, 0.0], dtype=np.float32))
+    with pytest.raises(RuntimeError, match="check_nan_inf.*log"):
+        paddle.log(x - 1.0)  # log(0) = -inf, log(-1) = nan
+    # finite path unaffected
+    y = paddle.log(x + 1.0)
+    assert np.isfinite(np.asarray(y.numpy())).all()
+
+
+def test_check_nan_inf_static():
+    paddle.enable_static()
+    try:
+        main, startup = paddle.static.Program(), paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [2], "float32")
+            y = paddle.log(x)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        with pytest.raises(RuntimeError, match="check_nan_inf.*log"):
+            exe.run(main, feed={"x": np.array([1.0, -1.0], np.float32)},
+                    fetch_list=[y])
+        out, = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32)},
+                       fetch_list=[y])
+        assert np.isfinite(out).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_profiler_host_events(tmp_path, capsys):
+    from paddle_tpu import profiler
+
+    path = str(tmp_path / "profile.json")
+    with profiler.profiler("CPU", "total", path):
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        for _ in range(3):
+            x = paddle.matmul(x, x)
+        (x.sum()).numpy()
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "matmul" in out
+    table = json.load(open(path))
+    assert table["matmul_v2"]["calls"] == 3 or any(
+        "matmul" in k and v["calls"] >= 3 for k, v in table.items())
+
+
+def test_record_event_nested():
+    from paddle_tpu import profiler
+
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+    profiler.stop_profiler()
+    # events recorded exactly once each
+    profiler.reset_profiler()
+
+
+def test_tpu_matmul_precision_flag():
+    import jax
+
+    paddle.set_flags({"FLAGS_tpu_matmul_precision": "float32"})
+    assert jax.config.jax_default_matmul_precision == "float32"
+    paddle.set_flags({"FLAGS_tpu_matmul_precision": "default"})
