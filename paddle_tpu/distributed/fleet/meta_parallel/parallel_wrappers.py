@@ -120,7 +120,10 @@ class PipelineParallel(MetaParallelBase):
 
     def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
         """Parity: pipeline_parallel.py:114 train_batch — splits data into
-        microbatches, runs pipelined fwd+bwd, applies the optimizer."""
+        ``accumulate_steps`` microbatches, runs the pipelined fwd+bwd and the
+        optimizer update in ONE jitted step (the optimizer's mode, betas,
+        weight decay, and global-norm clip are honored; its LR — scheduled or
+        constant — is read every call)."""
         x, y = data
         xa = x._array if isinstance(x, Tensor) else np.asarray(x)
         ya = y._array if isinstance(y, Tensor) else np.asarray(y)
@@ -133,30 +136,35 @@ class PipelineParallel(MetaParallelBase):
         xs = jnp.reshape(xa, (M, xa.shape[0] // M) + xa.shape[1:])
         ys = jnp.reshape(ya, (M, ya.shape[0] // M) + ya.shape[1:])
         engine = self._get_engine()
-
-        def loss_fn(out_mb, y_mb):
-            # user loss works on Tensors; run it untaped on the traced arrays
-            from ....dygraph import tracer
-
-            lf = self._loss_fn
-            old = tracer.set_grad_enabled(False)
-            try:
-                res = lf(Tensor(out_mb, stop_gradient=True),
-                         Tensor(y_mb, stop_gradient=True))
-                return res._array if isinstance(res, Tensor) else res
-            finally:
-                tracer.set_grad_enabled(old)
-
-        loss, grads = engine.forward_backward(xs, ys, loss_fn)
-        lr = optimizer.get_lr() if optimizer is not None else 1e-3
-        engine.apply_grads_sgd(grads, lr)
+        loss = engine.train_step(xs, ys, optimizer=optimizer)
+        # only an EXPLICIT scheduler is stepped (reference _optimizer_step
+        # semantics) — callers stepping optimizer._learning_rate themselves
+        # must not get a double advance
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(loss, stop_gradient=True)
 
+    def state_dict(self, *a, **k):
+        if self._engine is not None:
+            self._engine.sync_to_layers()
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        out = self._layers.set_state_dict(*a, **k)
+        if self._engine is not None:
+            self._engine.sync_from_layers()
+        return out
+
     def eval_batch(self, data, compute_loss=True):
         x, y = data
-        out = self._layers(x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
+        if self._engine is not None:
+            # pipelined jitted forward on the engine's device copies — no
+            # host round-trip of the weights
+            xa = x._array if isinstance(x, Tensor) else np.asarray(x)
+            out = Tensor(self._engine.eval_output(xa[None]),
+                         stop_gradient=True)
+        else:
+            out = self._layers(x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
         if compute_loss and self._loss_fn is not None:
             return self._loss_fn(out, y)
         return out

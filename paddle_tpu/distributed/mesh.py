@@ -70,8 +70,13 @@ def replicate(x):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
 
-def shard_batch(x, axis_names: Tuple[str, ...] = ("dp",)):
-    """Shard the leading (batch) dim over the given mesh axes."""
+def shard_batch(x, axis_names: Tuple[str, ...] = ("dp", "sharding")):
+    """Shard the leading (batch) dim over the given mesh axes.
+
+    'sharding' is included by default: ZeRO's sharding group IS a
+    data-parallel group (each sharding rank consumes different data; only
+    optimizer state/grads/params are partitioned — reference
+    fleet/meta_optimizers/sharding_optimizer.py semantics)."""
     mesh = ensure_default_mesh()
     names = tuple(a for a in axis_names if a in mesh.axis_names and mesh.shape[a] > 1)
     if not names:

@@ -20,7 +20,11 @@ class ParallelEnv:
     def __init__(self):
         self._rank = dist_env.get_rank()
         self._world_size = dist_env.get_world_size()
-        self._device_id = int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0] or 0)
+        from ..framework import flags as _flags
+
+        sel = _flags.flag("FLAGS_selected_tpus") or os.environ.get(
+            "FLAGS_selected_tpus", "0")
+        self._device_id = int(str(sel).split(",")[0] or 0)
 
     @property
     def rank(self):

@@ -99,6 +99,16 @@ def run_eager_kernel(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, A
     op_def = registry.get_op_def(op_type)
     if op_type in _NONJIT:
         return registry.run_kernel(op_def, ins, attrs, rng=rng)
+    # Already inside an outer trace (functional train steps, shard_map
+    # pipeline stages): run the kernel inline.  The per-op jit wrapper only
+    # speeds up true eager dispatch, and reusing its trace cache across
+    # sharding contexts is unsound — jax >= 0.9 avals carry the mesh and its
+    # axis types (Auto vs shard_map's Manual), so a kernel traced under one
+    # context poisons calls from the other ("Mesh for all inputs should be
+    # equal" at retrace).
+    if any(isinstance(a, jax.core.Tracer)
+           for vs in ins.values() for a in vs) or isinstance(rng, jax.core.Tracer):
+        return registry.run_kernel(op_def, ins, attrs, rng=rng)
     try:
         key = (op_type, registry._freeze(attrs))
         hash(key)

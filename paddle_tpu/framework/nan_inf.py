@@ -36,8 +36,16 @@ def op_all_finite(outs) -> jnp.ndarray:
 
 
 def assert_all_finite_eager(op_type: str, outs) -> None:
-    """Eager-mode check: host-syncs and raises on the first non-finite output."""
+    """Eager-mode check: host-syncs and raises on the first non-finite output.
+
+    Ops traced inside jit/shard_map/grad (functional train steps, the
+    pipeline engine) are skipped — a tracer can't be host-synced; traced
+    steps use :func:`op_all_finite` + :func:`raise_first_bad_op` instead."""
+    import jax
+
     for slot, i, v in _float_arrays(outs):
+        if isinstance(v, jax.core.Tracer):
+            continue
         a = np.asarray(v)
         if not np.isfinite(a).all():
             n_nan = int(np.isnan(a).sum())

@@ -192,6 +192,16 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # FLAGS_call_stack_level >= 2: remember where this op was built so
+        # executor errors can point at user code (ref op_call_stack.cc role)
+        from . import flags as _flags
+
+        if _flags.flag("FLAGS_call_stack_level") >= 2:
+            import traceback
+
+            self.callstack = "".join(traceback.format_stack(limit=12)[:-2])
+        else:
+            self.callstack = None
 
     def input(self, slot: str) -> List[str]:
         return self.inputs.get(slot, [])

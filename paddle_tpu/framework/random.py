@@ -29,11 +29,51 @@ def seed(s: int):
     return _state.key
 
 
+class trace_rng_scope:
+    """Thread a TRACED key through ops dispatched inside a jitted function.
+
+    Functional train steps (pipeline engine, custom jit wrappers) pass a
+    fresh per-step key as a jit argument and install it here around tracing;
+    rng consumers (dropout etc.) then draw traced subkeys from it, so every
+    executed step gets fresh randomness.  Without a scope, trace-time rng
+    draws fall back to baking a concrete key into the compiled program
+    (identical masks every step — fine only for deterministic eval)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        self._prev = getattr(_state, "trace_key", None)
+        _state.trace_key = self._key
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_key = self._prev
+        return False
+
+
 def next_rng_key():
+    tk = getattr(_state, "trace_key", None)
+    if tk is not None:
+        tk, sub = jax.random.split(tk)
+        _state.trace_key = tk
+        return sub
     key = getattr(_state, "key", None)
     if key is None:
         key = jax.random.PRNGKey(_DEFAULT_SEED)
-    key, sub = jax.random.split(key)
+    if isinstance(key, jax.core.Tracer):
+        # A pre-fix trace leaked a tracer into the chain; re-anchor. (The
+        # eval below keeps the chain concrete so this should not recur.)
+        key = jax.random.PRNGKey(_DEFAULT_SEED)
+    # The split must stay CONCRETE even when an op is being traced (jit /
+    # shard_map stage all binds, including ones on concrete inputs): storing
+    # a tracer into _state.key would poison every later eager op with a
+    # leaked tracer carrying the old trace's mesh context.  Trace-time rng
+    # consumers thus get a constant key baked into the compiled program —
+    # jitted training paths that need fresh per-step randomness thread their
+    # own keys (static executor: fold_in(seed, step)).
+    with jax.ensure_compile_time_eval():
+        key, sub = jax.random.split(key)
     _state.key = key
     return sub
 

@@ -51,8 +51,10 @@ def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0, rng=Non
     """Dispatch to the Pallas flash kernel on TPU when profitable, else the
     XLA-fused reference (dropout always takes the reference path)."""
     from . import flash
+    from ..framework import flags
 
-    if (flash.available() and q.shape[-2] >= 512
+    if (flags.flag("FLAGS_tpu_flash_attention")
+            and flash.available() and q.shape[-2] >= 512
             and flash.supported(q, k, mask=mask, dropout_p=dropout_p)):
         return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
     return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal,

@@ -208,6 +208,42 @@ class GPTPretrainingCriterion(nn.Layer):
 
 
 # ---------------------------------------------------------------------------
+# Pipeline-parallel GPT (PipelineLayer form)
+# ---------------------------------------------------------------------------
+
+
+def _embed_head_fwd(layer, x):
+    """Tied LM head: reuse the shared embedding weight (PaddleNLP
+    GPTForPretrainingPipe's SharedLayerDesc forward_func pattern)."""
+    return T.matmul(x, layer.word_embeddings.weight, transpose_y=True)
+
+
+def GPTForPretrainingPipe(cfg: GPTConfig, num_stages: Optional[int] = None,
+                          **kw):
+    """GPT as a ``PipelineLayer`` for the SPMD 1F1B engine.
+
+    Parity: PaddleNLP ``GPTForPretrainingPipe(PipelineLayer)`` — embedding on
+    stage 0 via SharedLayerDesc, decoder blocks pipelined, final LN + tied
+    head on the last stage.  Here the engine pipelines the homogeneous block
+    run over the 'pp' mesh axis and runs embedding/head replicated (engine
+    partition: pipeline_engine.PipelineEngine._partition).
+    """
+    from ..distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, SharedLayerDesc,
+    )
+
+    descs = [
+        SharedLayerDesc("embed", GPTEmbeddings, None, "weight", cfg),
+        *[LayerDesc(GPTBlock, cfg) for _ in range(cfg.num_layers)],
+        LayerDesc(nn.LayerNorm, cfg.hidden_size, epsilon=cfg.layer_norm_eps),
+        SharedLayerDesc("embed", GPTEmbeddings, _embed_head_fwd, "weight", cfg),
+    ]
+    return PipelineLayer(
+        layers=descs, num_stages=num_stages,
+        loss_fn=GPTPretrainingCriterion(), **kw)
+
+
+# ---------------------------------------------------------------------------
 # One-jit functional train step (the bench / multichip path)
 # ---------------------------------------------------------------------------
 
@@ -215,7 +251,8 @@ class GPTPretrainingCriterion(nn.Layer):
 def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                                 beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
                                 dp_axis="dp", remat: bool = True,
-                                ce_chunk_rows: int = 1024):
+                                ce_chunk_rows: int = 1024,
+                                sharding_stage: Optional[int] = None):
     """Compile fwd+bwd+AdamW into ONE donated XLA program over the hybrid mesh.
 
     Returns (step_fn, params, opt_state):
@@ -229,6 +266,18 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
     ids/labels are expected dp-sharded on the batch dim, so one jit covers
     dp x mp x pp.  ``remat=True`` wraps each block in jax.checkpoint — the
     reference's RecomputeOptimizer role (fluid/optimizer.py:5407).
+
+    ``sharding_stage`` = ZeRO over the 'sharding' mesh axis (parity:
+    ``fleet/meta_optimizers/sharding_optimizer.py:503`` and the dygraph
+    ``DygraphShardingOptimizer``), GSPMD-style:
+      * stage 1 — optimizer state (moments + fp32 masters) stored sharded;
+      * stage 2 — additionally, gradients are constrained to the sharded
+        layout so XLA reduce-scatters them (instead of all-reduce) and the
+        weight update runs in the sharded domain, all-gathering only the
+        updated weights;
+      * stage 3 — parameters THEMSELVES are stored sharded (FSDP); XLA
+        inserts the per-layer all-gathers in forward/backward.
+    Default: stage 2 when the 'sharding' axis is >1, else 0.
     """
     import jax
     import jax.numpy as jnp
@@ -240,6 +289,19 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
 
     mesh = mesh_mod.get_mesh()
     pp = mesh_mod.axis_size("pp")
+    shd = mesh_mod.axis_size("sharding")
+    if sharding_stage is None:
+        # honor DistributedStrategy.sharding_configs["stage"] when fleet is up
+        try:
+            from ..distributed import fleet as fleet_mod
+
+            strat = fleet_mod._fleet_state.get("strategy")
+            sharding_stage = int(strat.sharding_configs.get("stage", 2)) if (
+                strat is not None and shd > 1) else (2 if shd > 1 else 0)
+        except Exception:
+            sharding_stage = 2 if shd > 1 else 0
+    if shd <= 1:
+        sharding_stage = 0
 
     param_objs = list(model.parameters())
     blocks = list(model.gpt.blocks)
@@ -266,15 +328,36 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
             return spec
         return [None] * arr.ndim
 
+    def _add_sharding_axis(spec, shape):
+        """Insert the 'sharding' axis on the first free, divisible dim (ZeRO
+        partition choice — by-dim instead of the reference's greedy by-size
+        param partition, which GSPMD handles better)."""
+        out = list(spec)
+        used = set()
+        for s in out:
+            used.update(s if isinstance(s, tuple) else [s])
+        if "sharding" in used:
+            return out
+        for d, (s, n) in enumerate(zip(out, shape)):
+            if s is None and n > 0 and n % shd == 0:
+                out[d] = "sharding"
+                return out
+        return out
+
     def _mesh_put(arr):
         """Ensure every leaf lives on the hybrid mesh (replicated unless a TP
-        layer already installed a NamedSharding)."""
+        layer already installed a NamedSharding); ZeRO stage 3 stores params
+        sharded (FSDP)."""
         if mesh is None:
             return arr
         sh = getattr(arr, "sharding", None)
         if isinstance(sh, NamedSharding) and sh.mesh.devices.size == mesh.devices.size:
-            return arr
-        return jax.device_put(arr, NamedSharding(mesh, P()))
+            spec = _layer_spec(arr)
+        else:
+            spec = [None] * arr.ndim
+        if sharding_stage >= 3:
+            spec = _add_sharding_axis(spec, arr.shape)
+        return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
     other = [_mesh_put(p._array) for p in other_objs]
     stacked = []
@@ -287,8 +370,10 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                 # init peak matches the pp-sharded steady state.
                 host = np.stack([np.asarray(a) for a in leaves])
                 lead = "pp" if pp > 1 else None
-                st = jax.device_put(
-                    host, NamedSharding(mesh, P(lead, *_layer_spec(leaves[0]))))
+                spec = [lead] + _layer_spec(leaves[0])
+                if sharding_stage >= 3:
+                    spec = spec[:1] + _add_sharding_axis(spec[1:], host.shape[1:])
+                st = jax.device_put(host, NamedSharding(mesh, P(*spec)))
             else:
                 st = jnp.stack(leaves)
             stacked.append(st)
@@ -384,43 +469,81 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
     params_tree = (other, stacked)
     flat_params, treedef = jax.tree_util.tree_flatten(params_tree)
 
-    def _zeros_like_f32(p):
+    # per-leaf storage specs + ZeRO grad/opt-state specs
+    p_specs = [_layer_spec(p) for p in flat_params]
+    if sharding_stage >= 1:
+        opt_specs = [_add_sharding_axis(sp, p.shape)
+                     for sp, p in zip(p_specs, flat_params)]
+    else:
+        opt_specs = p_specs
+
+    def _sharding(spec):
+        return NamedSharding(mesh, P(*spec)) if mesh is not None else None
+
+    def _zeros_like_f32(p, spec):
         z = jnp.zeros(p.shape, jnp.float32)
-        if mesh is not None:
-            z = jax.device_put(z, p.sharding)
-        return z
+        sh = _sharding(spec)
+        return jax.device_put(z, sh) if sh is not None else z
 
     # AdamW state — moments AND master weights in fp32 even when compute
     # params are bf16 (mixed-precision parity: the reference's
     # multi_precision adam keeps FP32 master params; bf16-only updates round
-    # sub-ulp deltas to zero and stall training)
+    # sub-ulp deltas to zero and stall training).  Under ZeRO stage >= 1 the
+    # state lives sharded over the 'sharding' axis (1/N per device).
     low_precision = any(p.dtype != jnp.float32 for p in flat_params)
     opt_state = {
-        "m": [_zeros_like_f32(p) for p in flat_params],
-        "v": [_zeros_like_f32(p) for p in flat_params],
+        "m": [_zeros_like_f32(p, sp) for p, sp in zip(flat_params, opt_specs)],
+        "v": [_zeros_like_f32(p, sp) for p, sp in zip(flat_params, opt_specs)],
         "t": jnp.zeros((), jnp.int32),
     }
     if low_precision:
-        opt_state["master"] = [p.astype(jnp.float32) for p in flat_params]
+        masters = [p.astype(jnp.float32) for p in flat_params]
+        if sharding_stage >= 1 and mesh is not None:
+            masters = [jax.device_put(m, _sharding(sp))
+                       for m, sp in zip(masters, opt_specs)]
+        opt_state["master"] = masters
+
+    from ..framework import random as _fr
+
+    _base_seed = int(getattr(_fr, "_DEFAULT_SEED", 0))
 
     def step(params_tree, opt_state, ids, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(params_tree, ids, labels)
+        # fresh dropout masks per executed step without changing the step
+        # signature: fold the traced step counter into a constant base key
+        step_key = jax.random.fold_in(
+            jax.random.PRNGKey(_base_seed), opt_state["t"])
+
+        def lf(pt, i, l):
+            with _fr.trace_rng_scope(step_key):
+                return loss_fn(pt, i, l)
+
+        loss, grads = jax.value_and_grad(lf)(params_tree, ids, labels)
         t = opt_state["t"] + 1
         b1t = 1.0 - beta1 ** t.astype(jnp.float32)
         b2t = 1.0 - beta2 ** t.astype(jnp.float32)
         flat_p = jax.tree_util.tree_leaves(params_tree)
         flat_g = jax.tree_util.tree_leaves(grads)
+        if sharding_stage >= 2 and mesh is not None:
+            # ZeRO-2: land the gradient sum in the sharded layout — XLA emits
+            # a reduce-scatter over 'sharding' (x 'dp') instead of all-reduce
+            flat_g = [lax.with_sharding_constraint(g, _sharding(sp))
+                      for g, sp in zip(flat_g, opt_specs)]
         masters = opt_state.get("master", flat_p)
         new_p, new_m, new_v, new_master = [], [], [], []
-        for p, w32, g, m, v in zip(flat_p, masters, flat_g,
-                                   opt_state["m"], opt_state["v"]):
+        for i, (p, w32, g, m, v) in enumerate(zip(flat_p, masters, flat_g,
+                                                  opt_state["m"], opt_state["v"])):
             gf = g.astype(jnp.float32)
             m2 = beta1 * m + (1 - beta1) * gf
             v2 = beta2 * v + (1 - beta2) * jnp.square(gf)
             upd = (m2 / b1t) / (jnp.sqrt(v2 / b2t) + eps) + wd * w32.astype(jnp.float32)
             w_new = w32.astype(jnp.float32) - lr * upd
             new_master.append(w_new)
-            new_p.append(w_new.astype(p.dtype))
+            pn = w_new.astype(p.dtype)
+            if sharding_stage >= 2 and mesh is not None:
+                # stage 2: all-gather the updated weights back to the stored
+                # layout; stage 3: p_spec itself is sharded (FSDP) — no gather
+                pn = lax.with_sharding_constraint(pn, _sharding(p_specs[i]))
+            new_p.append(pn)
             new_m.append(m2)
             new_v.append(v2)
         new_state = {"m": new_m, "v": new_v, "t": t}

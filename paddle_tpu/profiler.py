@@ -115,13 +115,20 @@ def stop_profiler(sorted_key: Optional[str] = None,
                   profile_path: str = "/tmp/profile") -> None:
     """End collection: stop the device trace, print the host event table,
     dump it as JSON to ``profile_path``."""
+    if sorted_key not in _SORTERS:  # validate BEFORE tearing down state
+        raise ValueError("sorted_key should be None, 'calls', 'total', "
+                         "'max', 'min' or 'ave', got %r" % (sorted_key,))
     if not _state["enabled"]:
         return
     _state["enabled"] = False
     if _state["trace"]:
         jax.profiler.stop_trace()
         _state["trace"] = False
-    _print_report(sorted_key, profile_path)
+    try:
+        _print_report(sorted_key, profile_path)
+    finally:
+        # a later CPU-only session must not report this session's device trace
+        _state["logdir"] = None
 
 
 _SORTERS = {

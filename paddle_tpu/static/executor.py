@@ -180,9 +180,14 @@ class Executor:
                 seen_in.add(n)
                 state_in.append(n)
                 if not var.persistable and scope.find_var(n) is None:
+                    where = (
+                        f"\nOp built at (FLAGS_call_stack_level>=2):\n"
+                        f"{op.callstack}" if getattr(op, "callstack", None)
+                        else "")
                     raise RuntimeError(
                         f"Op {op.type} reads variable {n!r} which is neither "
                         f"fed, produced earlier, nor present in the scope"
+                        + where
                     )
             for n in op.output_arg_names:
                 if n:

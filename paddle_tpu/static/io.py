@@ -51,6 +51,24 @@ def load(program: fw.Program, model_path: str, executor=None, scope=None):
         scope.set(name, jnp.asarray(data[name]))
 
 
+def _prune_for_inference(program, feed_names, fetch_names):
+    """Backward-slice the global block to the ops the fetch targets need.
+
+    Parity: ``fluid/framework.py`` ``Program._prune_with_input`` /
+    ``_prune_backward`` used by save_inference_model — drops loss,
+    backward, and optimizer ops from the saved inference program."""
+    block = program.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(o in needed for o in op.output_arg_names):
+            keep.append(op)
+            needed.update(n for n in op.input_arg_names
+                          if n not in feed_names)
+    keep.reverse()
+    block.ops = keep
+
+
 def save_inference_model(
     path_prefix: str,
     feed_vars: List[fw.Variable],
@@ -63,6 +81,8 @@ def save_inference_model(
     program (cloned for test) + persistables."""
     program = program or fw.default_main_program()
     infer_prog = program.clone(for_test=True)
+    _prune_for_inference(infer_prog, [v.name for v in feed_vars],
+                         [v.name for v in fetch_vars])
     meta = {
         "program": infer_prog.to_dict(),
         "feed_names": [v.name for v in feed_vars],

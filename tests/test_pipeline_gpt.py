@@ -1,0 +1,169 @@
+"""Pipelined GPT training must match single-device training.
+
+Round-3 verdict item 1(c): GPT-tiny through PipelineLayer + PipelineEngine
+(SPMD 1F1B over the 'pp' mesh axis, in-jit AdamW with global-norm clip)
+vs the same model trained single-device in dygraph — losses must coincide.
+Parity target: ``/root/reference/python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py:114`` (train_batch).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import meta_parallel as mpp
+from paddle_tpu.models import GPTForPretraining
+from paddle_tpu.models.gpt import (
+    GPTConfig,
+    GPTForPretrainingPipe,
+    GPTPretrainingCriterion,
+)
+
+
+def _strategy(pp=2, acc=4):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 1, "pp_degree": pp, "sharding_degree": 1,
+    }
+    s.pipeline_configs = {"accumulate_steps": acc, "micro_batch_size": 2}
+    return s
+
+
+def _unique_params(layer):
+    seen, out = set(), []
+    for p in layer.parameters():
+        if id(p) not in seen:
+            seen.add(id(p))
+            out.append(p)
+    return out
+
+
+CFG = dict(vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+           max_seq_len=32, dropout=0.0)
+
+
+def _make_adamw(params):
+    return opt.AdamW(learning_rate=1e-3, parameters=params, weight_decay=0.01,
+                     grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+
+def test_pipeline_gpt_matches_single_device():
+    cfg = GPTConfig(**CFG)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype("int32")
+    labels = rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64")
+
+    # ---- single-device dygraph reference --------------------------------
+    paddle.seed(0)
+    ref = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    ref_params = _unique_params(ref)
+    ref_opt = _make_adamw(ref_params)
+    ref_losses = []
+    for _ in range(4):
+        loss = crit(ref(paddle.to_tensor(ids)), paddle.to_tensor(labels))
+        loss.backward()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        ref_losses.append(float(loss.numpy()))
+
+    # ---- pipelined (pp=2, 4 microbatches) -------------------------------
+    fleet.init(is_collective=True, strategy=_strategy(pp=2, acc=4))
+    paddle.seed(0)
+    pipe = GPTForPretrainingPipe(cfg, num_stages=2)
+    pipe_params = _unique_params(pipe)
+    assert [tuple(p.shape) for p in pipe_params] == \
+        [tuple(p.shape) for p in ref_params]
+    # identical starting point
+    paddle.seed(0)
+    ref2 = GPTForPretraining(cfg)
+    for p, q in zip(pipe_params, _unique_params(ref2)):
+        p._array = q._array
+
+    model = mpp.PipelineParallel(pipe, fleet.get_hybrid_communicate_group(),
+                                 _strategy(pp=2, acc=4))
+    model.accumulate_steps = 4
+    pipe_opt = _make_adamw(pipe_params)
+    pipe_losses = []
+    for _ in range(4):
+        loss = model.train_batch(
+            (paddle.to_tensor(ids), paddle.to_tensor(labels)),
+            optimizer=pipe_opt)
+        pipe_losses.append(float(loss.numpy()))
+
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=2e-4, atol=2e-4)
+    assert pipe_losses[-1] < pipe_losses[0]
+
+    # params written back through state_dict match the reference's trajectory
+    sd = model.state_dict()
+    ref_sd = ref.state_dict()
+    assert len(sd) >= len(ref_sd) - 2  # tied head aliases the embedding
+    total, close = 0, 0
+    for p, q in zip(_unique_params(pipe), ref_params):
+        total += 1
+        if np.allclose(np.asarray(p._array), np.asarray(q._array),
+                       rtol=5e-3, atol=5e-4):
+            close += 1
+    assert close == total, f"only {close}/{total} params match after training"
+
+
+def test_pipeline_gpt_scheduler_and_momentum():
+    """Scheduled LR + Momentum mode through the pipelined step."""
+    cfg = GPTConfig(**CFG)
+    fleet.init(is_collective=True, strategy=_strategy(pp=2, acc=2))
+    paddle.seed(1)
+    pipe = GPTForPretrainingPipe(cfg, num_stages=2)
+    sched = opt.lr.StepDecay(learning_rate=0.05, step_size=1, gamma=0.5)
+    o = opt.Momentum(learning_rate=sched, momentum=0.9,
+                     parameters=_unique_params(pipe))
+    model = mpp.PipelineParallel(pipe, fleet.get_hybrid_communicate_group(),
+                                 _strategy(pp=2, acc=2))
+    model.accumulate_steps = 2
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype("int32")
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype("int64")
+    losses = []
+    for _ in range(3):
+        loss = model.train_batch(
+            (paddle.to_tensor(ids), paddle.to_tensor(labels)), optimizer=o,
+            lr_scheduler=sched)
+        losses.append(float(loss.numpy()))
+    assert all(np.isfinite(losses))
+    assert sched.last_epoch == 3  # explicit scheduler stepped per train_batch
+    assert losses[-1] < losses[0]
+
+    # pipelined eval path (engine.eval_output) agrees with the whole-stack
+    # eager forward after syncing weights back
+    ev = model.eval_batch((paddle.to_tensor(ids), paddle.to_tensor(labels)))
+    model.state_dict()  # forces sync_to_layers
+    ref = pipe(paddle.to_tensor(ids))
+    ref_loss = pipe._loss_fn(ref, paddle.to_tensor(labels))
+    np.testing.assert_allclose(float(ev.numpy()), float(ref_loss.numpy()),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_dropout_rng_is_fresh_per_step():
+    """The per-step rng key is a jit ARGUMENT (trace_rng_scope), so dropout
+    masks change between executed steps instead of being baked constants."""
+    cfg = GPTConfig(**{**CFG, "dropout": 0.3})
+    fleet.init(is_collective=True, strategy=_strategy(pp=2, acc=2))
+    paddle.seed(7)
+    pipe = GPTForPretrainingPipe(cfg, num_stages=2)
+    model = mpp.PipelineParallel(pipe, fleet.get_hybrid_communicate_group(),
+                                 _strategy(pp=2, acc=2))
+    model.accumulate_steps = 2
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype("int32")
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype("int64")
+    # SGD lr=0: params never change, so any loss difference across steps can
+    # only come from fresh dropout masks
+    o = opt.SGD(learning_rate=0.0, parameters=_unique_params(pipe))
+    l1 = float(model.train_batch((paddle.to_tensor(ids),
+                                  paddle.to_tensor(labels)), optimizer=o).numpy())
+    l2 = float(model.train_batch((paddle.to_tensor(ids),
+                                  paddle.to_tensor(labels)), optimizer=o).numpy())
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert l1 != l2, "dropout mask identical across steps (baked rng)"
