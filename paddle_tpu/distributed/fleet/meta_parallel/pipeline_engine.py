@@ -161,7 +161,9 @@ def _apply_update(mode: str, hyper, flat_params, flat_grads, opt_state, lr):
     op kernels (sgd/momentum/adam/adamw from ops/optimizer_ops.py)."""
     from ....ops import optimizer_ops as K
 
-    l2 = hyper.get("l2", 0.0)
+    # NOTE: L2 regularization is folded into the grads BEFORE this function
+    # (and before clipping) by the caller — eager Optimizer.step order is
+    # _apply_regularization THEN _apply_clip (optimizer/__init__.py:217).
     # adamw per-param decay mask (apply_decay_param_fun): True = decay
     decay_mask = hyper.get("decay_mask") or (True,) * len(flat_params)
     masters = opt_state.get("master")
@@ -169,17 +171,13 @@ def _apply_update(mode: str, hyper, flat_params, flat_grads, opt_state, lr):
     new_p, new_master, new_state = [], [], {}
     if mode == "sgd":
         for p, w, g in zip(flat_params, work_p, flat_grads):
-            if l2:
-                g = g + l2 * w.astype(g.dtype)
             w_new = K.sgd_kernel(
                 {"Param": w, "Grad": g, "LearningRate": lr}, {})["ParamOut"]
             new_master.append(w_new)
             new_p.append(w_new.astype(p.dtype))
     elif mode == "momentum":
         attrs = {"mu": hyper["momentum"],
-                 "use_nesterov": hyper.get("use_nesterov", False),
-                 "regularization_method": "l2_decay" if l2 else "",
-                 "regularization_coeff": l2}
+                 "use_nesterov": hyper.get("use_nesterov", False)}
         vels = []
         for p, w, g, v in zip(flat_params, work_p, flat_grads,
                               opt_state["velocity"]):
@@ -199,8 +197,6 @@ def _apply_update(mode: str, hyper, flat_params, flat_grads, opt_state, lr):
         for i, (p, w, g, m, v) in enumerate(zip(flat_params, work_p, flat_grads,
                                                 opt_state["m"], opt_state["v"])):
             gf = g.astype(jnp.float32)
-            if l2:
-                gf = gf + l2 * w.astype(jnp.float32)
             if mode == "adamw":
                 kernel = K.adamw_kernel
                 attrs = dict(base_attrs, coeff=hyper.get("coeff", 0.01),
@@ -343,6 +339,15 @@ class PipelineEngine:
                 "layers with identical parameter structure (e.g. transformer "
                 f"blocks); longest run is {run_len} for {self.S} stages"
             )
+        if usable < run_len:
+            import warnings
+
+            warnings.warn(
+                f"pipeline stage partition: {run_len - usable} of {run_len} "
+                f"homogeneous layers do not divide into {self.S} stages and "
+                f"will run REPLICATED in the epilogue (duplicated compute, "
+                f"no pp memory scaling for them); prefer num_layers a "
+                f"multiple of num_stages")
         hi = lo + usable
         self._pro = self._funcs[:lo]
         self._mid = self._funcs[lo:hi]
@@ -403,9 +408,15 @@ class PipelineEngine:
     def sync_from_layers(self):
         """Re-materialize the engine's device copies FROM the layer objects —
         required after set_state_dict / checkpoint load, which rewrite the
-        Tensors the engine snapshotted at construction."""
+        Tensors the engine snapshotted at construction.  fp32 master weights
+        re-seed from the loaded params (otherwise the next step would resume
+        the pre-load trajectory and overwrite the checkpoint); moments are
+        kept, matching eager set_state_dict semantics."""
         self._materialize()
         self._dirty = False
+        if self.opt_state is not None and "master" in self.opt_state:
+            flat_p = jax.tree_util.tree_leaves((self.other, self.stacked))
+            self.opt_state["master"] = [p.astype(jnp.float32) for p in flat_p]
 
     def sync_to_layers(self):
         """Write the engine's (possibly updated) params back into the layer
@@ -454,8 +465,10 @@ class PipelineEngine:
             p._array = a
         return saved
 
-    def _loss_arrays(self, other_arrays, stacked, xs_mb, ys_mb, apply):
-        """Full forward + loss on traced arrays.  xs_mb: (M, mb, ...)."""
+    def _forward_arrays(self, other_arrays, stacked, xs_mb, apply):
+        """prologue -> pipelined middle -> epilogue on traced arrays.
+        xs_mb: (M, mb, ...); returns the epilogue output Tensor for the
+        flattened batch."""
         from ....dygraph import tracer
         from ....dygraph.tensor import Tensor
 
@@ -466,17 +479,27 @@ class PipelineEngine:
             flat = xs_mb.reshape((-1,) + xs_mb.shape[2:])
             t = self._run_entries(self._pro, Tensor(flat, stop_gradient=True))
             h = t._array if isinstance(t, Tensor) else t
-            h_mb = h.reshape((M, -1) + h.shape[1:])
-            y = apply(stacked, h_mb)
+            y = apply(stacked, h.reshape((M, -1) + h.shape[1:]))
             out = y.reshape((-1,) + y.shape[2:])
-            t = self._run_entries(self._epi, Tensor(out, stop_gradient=True))
+            return self._run_entries(self._epi, Tensor(out, stop_gradient=True))
+        finally:
+            tracer.set_grad_enabled(og)
+            self._swap_other(saved)
+
+    def _loss_arrays(self, other_arrays, stacked, xs_mb, ys_mb, apply):
+        """Full forward + loss on traced arrays.  xs_mb: (M, mb, ...)."""
+        from ....dygraph import tracer
+        from ....dygraph.tensor import Tensor
+
+        t = self._forward_arrays(other_arrays, stacked, xs_mb, apply)
+        og = tracer.set_grad_enabled(False)
+        try:
             ys_flat = ys_mb.reshape((-1,) + ys_mb.shape[2:])
             res = self.loss_fn(t, Tensor(ys_flat, stop_gradient=True))
             loss = res._array if isinstance(res, Tensor) else jnp.asarray(res)
             return jnp.mean(loss)
         finally:
             tracer.set_grad_enabled(og)
-            self._swap_other(saved)
 
     # -- compiled train step ----------------------------------------------
     def _get_step(self, mode: str, hyper: dict, clip_norm):
@@ -499,6 +522,11 @@ class PipelineEngine:
             loss, grads = jax.value_and_grad(total)((other, stacked))
             flat_p, treedef = jax.tree_util.tree_flatten((other, stacked))
             flat_g = jax.tree_util.tree_leaves(grads)
+            l2 = hyper.get("l2", 0.0)
+            if l2:
+                # regularization BEFORE clip — eager Optimizer.step order
+                flat_g = [g + l2 * p.astype(g.dtype)
+                          for p, g in zip(flat_p, flat_g)]
             if clip_norm is not None:
                 flat_g = _clip_by_global_norm(flat_g, clip_norm)
             new_p, new_state = _apply_update(
@@ -548,29 +576,30 @@ class PipelineEngine:
 
     def eval_output(self, xs_mb):
         """Pipelined forward only (no loss): returns the epilogue output for
-        the flattened batch.  The jitted forward is cached on the engine."""
-        if self._eval_fn is None:
-            from ....dygraph import tracer
-            from ....dygraph.tensor import Tensor
+        the flattened batch.  The jitted forward is cached on the engine and
+        TRACED IN EVAL MODE (dropout etc. off) regardless of the layers'
+        current training flag — this is the inference path, and the flag is
+        only read at trace time."""
+        from ....dygraph.tensor import Tensor
+        from ....nn.layer_base import Layer
 
+        xs = jnp.asarray(xs_mb)
+        if self._eval_fn is None:
             apply = spmd_pipeline(self._stage_fn, self.S, self.axis)
+            mods = [l for l, _ in self._funcs if isinstance(l, Layer)]
 
             @jax.jit
             def fwd(other, stacked, xs):
-                M = xs.shape[0]
-                saved = self._swap_other(other)
-                og = tracer.set_grad_enabled(False)
+                # body runs only at trace time: force eval mode for the trace
+                was = [m.training for m in mods]
+                for m in mods:
+                    m.eval()
                 try:
-                    flat = xs.reshape((-1,) + xs.shape[2:])
-                    t = self._run_entries(self._pro, Tensor(flat, stop_gradient=True))
-                    h = t._array if isinstance(t, Tensor) else t
-                    y = apply(stacked, h.reshape((M, -1) + h.shape[1:]))
-                    out = y.reshape((-1,) + y.shape[2:])
-                    t = self._run_entries(self._epi, Tensor(out, stop_gradient=True))
+                    t = self._forward_arrays(other, stacked, xs, apply)
                     return t._array if isinstance(t, Tensor) else t
                 finally:
-                    tracer.set_grad_enabled(og)
-                    self._swap_other(saved)
+                    for m, tr in zip(mods, was):
+                        (m.train() if tr else m.eval())
 
             self._eval_fn = fwd
-        return self._eval_fn(self.other, self.stacked, jnp.asarray(xs_mb))
+        return self._eval_fn(self.other, self.stacked, xs)

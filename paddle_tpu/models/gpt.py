@@ -505,13 +505,14 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
 
     from ..framework import random as _fr
 
-    _base_seed = int(getattr(_fr, "_DEFAULT_SEED", 0))
+    # drawn from the LIVE seed chain so paddle.seed() controls dropout noise
+    # in this path like everywhere else
+    _base_key = _fr.next_rng_key()
 
     def step(params_tree, opt_state, ids, labels):
         # fresh dropout masks per executed step without changing the step
         # signature: fold the traced step counter into a constant base key
-        step_key = jax.random.fold_in(
-            jax.random.PRNGKey(_base_seed), opt_state["t"])
+        step_key = jax.random.fold_in(_base_key, opt_state["t"])
 
         def lf(pt, i, l):
             with _fr.trace_rng_scope(step_key):

@@ -110,7 +110,11 @@ class OpTest:
         analytic = exe.run(prog, feed=feed, fetch_list=grad_names, scope=Scope())
 
         for slot, g_analytic in zip(inputs_to_check, analytic):
-            base = np.asarray(feed[f"in_{slot}"], dtype=np.float64)
+            # ascontiguousarray: an F-ordered feed (e.g. a transposed view)
+            # would make zeros_like F-ordered, turning .reshape(-1) into a
+            # COPY — FD writes would silently vanish
+            base = np.ascontiguousarray(
+                np.asarray(feed[f"in_{slot}"], dtype=np.float64))
             g_numeric = np.zeros_like(base)
             flat = base.reshape(-1)
             gflat = g_numeric.reshape(-1)
