@@ -8,12 +8,11 @@ machinery of the reference maps to ``jax.distributed`` + mesh axes; the
 
 from .env import get_rank, get_world_size  # noqa: F401
 
-try:  # collective/fleet surfaces land with the distributed build stage
-    from .parallel import init_parallel_env, ParallelEnv  # noqa: F401
-    from .collective import (  # noqa: F401
-        all_gather, all_reduce, alltoall, barrier, broadcast, new_group,
-        recv, reduce, scatter, send, split, wait, ReduceOp,
-    )
-    from . import fleet  # noqa: F401
-except ImportError:  # pragma: no cover - during bring-up
-    pass
+from .parallel import init_parallel_env, ParallelEnv  # noqa: F401
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, alltoall, barrier, broadcast, new_group,
+    recv, reduce, scatter, send, split, wait, ReduceOp,
+)
+from . import fleet  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401

@@ -1,0 +1,11 @@
+"""``python -m paddle_tpu.distributed.fleet.launch`` — reference-path alias.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/launch.py`` (the
+module users actually invoke); implementation lives in
+``paddle_tpu.distributed.launch``.
+"""
+
+from ..launch import launch, main  # noqa: F401
+
+if __name__ == "__main__":
+    main()

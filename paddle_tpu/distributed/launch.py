@@ -1,0 +1,98 @@
+"""``python -m paddle_tpu.distributed.launch`` — the fleet launcher CLI.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/launch.py:441``
+(``launch_collective``) and its arg surface (``--ips``, ``--gpus``→
+``--devices``, ``--log_dir``, training_script + args).  Produces the
+``PADDLE_*`` env protocol consumed by
+``paddle_tpu.distributed.parallel.init_parallel_env``; rendezvous is
+``jax.distributed.initialize`` against ``PADDLE_MASTER:MASTER_PORT``
+(replacing the reference's gen_endpoints + NCCL id broadcast).
+
+Usage:
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 train.py --lr 0.1
+    python -m paddle_tpu.distributed.launch --ips=10.0.0.1,10.0.0.2 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .launch_utils import (
+    Cluster,
+    find_free_port,
+    start_local_trainers,
+    watch_local_trainers,
+)
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed trainers (fleet launch parity)")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma list of node IPs; this node must appear in it")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="trainers per node (default: one, or one per entry "
+                        "in --devices)")
+    p.add_argument("--devices", "--gpus", "--tpus", dest="devices", type=str,
+                   default=None,
+                   help="comma list of device ids to bind, one trainer each")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator host[:port] (default: first ip)")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="this node's index in --ips (default: inferred)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank workerlog.N files here")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="seconds to wait before killing trainers")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_from_args(args) -> tuple:
+    ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
+    devices = ([d.strip() for d in args.devices.split(",")]
+               if args.devices else None)
+    nproc = args.nproc_per_node or (len(devices) if devices else 1)
+    if args.master:
+        host, _, port = args.master.partition(":")
+        master, master_port = host, int(port or find_free_port())
+    else:
+        master = ips[0]
+        master_port = find_free_port() if ips == ["127.0.0.1"] else 8476
+    node_rank = args.node_rank
+    if node_rank is None:
+        import socket
+
+        names = {"127.0.0.1", "localhost", socket.gethostname()}
+        try:
+            names.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        node_rank = next((i for i, ip in enumerate(ips) if ip in names), 0)
+    cluster = Cluster(ips=ips, nproc_per_node=nproc, master=master,
+                      master_port=master_port, node_rank=node_rank)
+    return cluster, devices
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    cluster, devices = get_cluster_from_args(args)
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    procs = start_local_trainers(cluster, cmd, base_env=dict(os.environ),
+                                 log_dir=args.log_dir, devices=devices)
+    print(f"launch: {cluster.nproc_per_node} local trainer(s), world size "
+          f"{cluster.world_size}, master {cluster.master}:{cluster.master_port}",
+          flush=True)
+    return watch_local_trainers(procs, timeout=args.timeout)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Launcher plumbing: cluster description + per-rank env protocol.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/launch_utils.py``
+(``get_cluster``:271, ``start_local_trainers``:457 building the
+``PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / FLAGS_selected_gpus`` env) — TPU-first: the env
+additionally carries ``PADDLE_MASTER``/``MASTER_PORT`` so
+``init_parallel_env`` can call ``jax.distributed.initialize`` (the
+rendezvous the reference does with its own TCP store + NCCL id exchange).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class TrainerProc:
+    rank: int
+    proc: subprocess.Popen
+    log_path: Optional[str] = None
+    log_file: Optional[object] = None
+
+
+@dataclass
+class Cluster:
+    """One node's worth of trainers (multi-node: this process launches only
+    the local ranks; `ips` orders the global ranks)."""
+
+    ips: List[str]
+    nproc_per_node: int
+    master: str
+    master_port: int
+    node_rank: int = 0
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ips) * self.nproc_per_node
+
+    def endpoints(self) -> List[str]:
+        eps = []
+        base_port = self.master_port + 1
+        for ip in self.ips:
+            for i in range(self.nproc_per_node):
+                eps.append(f"{ip}:{base_port + i}")
+        return eps
+
+    def local_ranks(self) -> List[int]:
+        start = self.node_rank * self.nproc_per_node
+        return list(range(start, start + self.nproc_per_node))
+
+
+def rank_env(cluster: Cluster, rank: int, devices: Optional[str] = None
+             ) -> Dict[str, str]:
+    """The PADDLE_* env protocol for one trainer (launch_utils.py:457)."""
+    eps = cluster.endpoints()
+    local = rank % cluster.nproc_per_node
+    env = {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_CURRENT_ENDPOINT": eps[rank],
+        "PADDLE_TRAINERS_NUM": str(cluster.world_size),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+        "PADDLE_RANK_IN_NODE": str(local),
+        "PADDLE_LOCAL_DEVICE_IDS": devices if devices is not None else str(local),
+        "PADDLE_MASTER": cluster.master,
+        "MASTER_ADDR": cluster.master,
+        "MASTER_PORT": str(cluster.master_port),
+        "POD_IP": cluster.ips[cluster.node_rank],
+        "FLAGS_selected_tpus": devices if devices is not None else str(local),
+    }
+    return env
+
+
+def start_local_trainers(cluster: Cluster, cmd: List[str],
+                         base_env: Optional[Dict[str, str]] = None,
+                         log_dir: Optional[str] = None,
+                         devices: Optional[List[str]] = None
+                         ) -> List[TrainerProc]:
+    procs = []
+    for rank in cluster.local_ranks():
+        env = dict(base_env if base_env is not None else os.environ)
+        dev = devices[rank % cluster.nproc_per_node] if devices else None
+        env.update(rank_env(cluster, rank, dev))
+        log_file = log_path = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"workerlog.{rank}")
+            log_file = open(log_path, "w")
+        p = subprocess.Popen(cmd, env=env, stdout=log_file, stderr=log_file)
+        procs.append(TrainerProc(rank=rank, proc=p, log_path=log_path,
+                                 log_file=log_file))
+    return procs
+
+
+def watch_local_trainers(procs: List[TrainerProc], timeout: Optional[float]
+                         = None) -> int:
+    """Wait for all trainers; on the first failure, terminate the rest
+    (launch_utils.py watch_local_trainers / terminate semantics).  Returns
+    the overall exit code."""
+    deadline = time.time() + timeout if timeout else None
+    alive = {t.rank: t for t in procs}
+    rc = 0
+    try:
+        while alive:
+            for rank, t in list(alive.items()):
+                code = t.proc.poll()
+                if code is None:
+                    continue
+                del alive[rank]
+                if code != 0:
+                    sys.stderr.write(
+                        f"trainer {rank} exited with code {code}"
+                        + (f" (log: {t.log_path})" if t.log_path else "")
+                        + "\n")
+                    rc = rc or code
+            if alive and rc:
+                break  # one failed: stop waiting, kill the rest
+            if deadline and time.time() > deadline:
+                sys.stderr.write("launch: timeout waiting for trainers\n")
+                rc = rc or 124
+                break
+            time.sleep(0.2)
+    finally:
+        for t in alive.values():
+            try:
+                t.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for t in alive.values():
+            try:
+                t.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                t.proc.kill()
+        for t in procs:
+            if t.log_file:
+                t.log_file.close()
+    return rc

@@ -1,0 +1,90 @@
+"""``paddle.distributed.spawn`` — in-Python multi-process launch.
+
+Parity: ``/root/reference/python/paddle/distributed/spawn.py`` (``spawn``:
+func + args + nprocs + join, per-process env prepared by
+``_prepare_trainer_env``).  Each child gets the same ``PADDLE_*`` protocol
+the CLI launcher produces, then runs ``func(*args)``; rank is available via
+``paddle.distributed.get_rank()`` / ``ParallelEnv`` as in the reference.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Sequence
+
+from .launch_utils import Cluster, find_free_port, rank_env
+
+
+class MultiprocessContext:
+    """Parity: spawn.py MultiprocessContext — join/terminate over the pool."""
+
+    def __init__(self, processes, error_queues):
+        self.processes = processes
+        self.error_queues = error_queues
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        failed = [(i, p.exitcode) for i, p in enumerate(self.processes)
+                  if p.exitcode not in (0, None)]
+        if failed:
+            for p in self.processes:
+                if p.is_alive():
+                    p.terminate()
+            msgs = []
+            for i, code in failed:
+                err = ""
+                try:
+                    if not self.error_queues[i].empty():
+                        err = self.error_queues[i].get()
+                except OSError:
+                    pass
+                msgs.append(f"rank {i} exited with code {code}\n{err}")
+            raise RuntimeError("spawn: trainer failure:\n" + "\n".join(msgs))
+        return all(p.exitcode is not None for p in self.processes)
+
+
+def _worker(func, args, env, error_queue):
+    try:
+        os.environ.update(env)
+        func(*args)
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        error_queue.put(traceback.format_exc())
+        raise
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """Spawn ``nprocs`` processes running ``func(*args)`` with the PADDLE_*
+    env protocol installed (reference spawn.py semantics)."""
+    if nprocs == -1:
+        try:
+            import jax
+
+            nprocs = max(jax.local_device_count(), 1)
+        except Exception:
+            nprocs = 1
+    cluster = Cluster(ips=["127.0.0.1"], nproc_per_node=nprocs,
+                      master="127.0.0.1",
+                      master_port=int(options.get("master_port")
+                                      or find_free_port()))
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    processes, error_queues = [], []
+    for rank in range(nprocs):
+        env = rank_env(cluster, rank, devices=str(rank))
+        env.update(options.get("env", {}))
+        q = ctx.SimpleQueue()
+        p = ctx.Process(target=_worker, args=(func, tuple(args), env, q),
+                        daemon=daemon)
+        p.start()
+        processes.append(p)
+        error_queues.append(q)
+    context = MultiprocessContext(processes, error_queues)
+    if not join:
+        return context
+    context.join()
+    return context

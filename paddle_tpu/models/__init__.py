@@ -7,7 +7,10 @@ TPU-first (fused SDPA, TP/PP-ready blocks, one-jit train step).
 """
 
 from .gpt import (  # noqa: F401
-    GPTConfig, GPTForPretraining, GPTModel, GPTPretrainingCriterion,
-    build_functional_train_step, gpt_tiny, gpt_small, gpt_medium, gpt_1p3b, gpt_13b,
+    GPTConfig, GPTForPretraining, GPTForPretrainingPipe, GPTModel,
+    GPTPretrainingCriterion, build_functional_train_step,
+    gpt_tiny, gpt_small, gpt_medium, gpt_1p3b, gpt_13b,
 )
-from .bert import BertConfig, BertModel, BertForPretraining  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
+)

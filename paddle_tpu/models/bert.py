@@ -99,7 +99,15 @@ class BertModel(nn.Layer):
 
 
 class BertForPretraining(nn.Layer):
-    """MLM + NSP heads (BERT pretraining objective)."""
+    """MLM + NSP heads (BERT pretraining objective).
+
+    Parity: PaddleNLP ``BertForPretraining`` (BertPretrainingHeads: the
+    transform + LN + decoder tied to the word embedding, and the NSP
+    classifier over the pooled output).  ``masked_positions`` gathers the
+    masked token states BEFORE the LM head — only |masked| rows hit the
+    (h, vocab) matmul, the same compute saving the reference gets from
+    ``paddle.gather`` in BertPretrainingHeads.forward.
+    """
 
     def __init__(self, model_or_cfg):
         super().__init__()
@@ -109,10 +117,51 @@ class BertForPretraining(nn.Layer):
         self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, ids, token_type_ids=None, attn_mask=None):
+    def forward(self, ids, token_type_ids=None, attn_mask=None,
+                masked_positions=None):
         seq, pooled = self.bert(ids, token_type_ids, attn_mask)
-        h = self.ln(F.gelu(self.transform(seq)))
+        if masked_positions is not None:
+            b, s, h = seq.shape
+            flat = T.reshape(seq, [b * s, h])
+            seq = T.gather(flat, T.reshape(masked_positions, [-1]))
+        h_out = self.ln(F.gelu(self.transform(seq)))
         w = self.bert.word_embeddings.weight
-        mlm_logits = T.matmul(h, w, transpose_y=True)
+        mlm_logits = T.matmul(h_out, w, transpose_y=True)
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """MLM CE (ignore_index=-1 outside masked tokens) + NSP CE.
+
+    Parity: PaddleNLP ``BertPretrainingCriterion.forward`` — masked-LM
+    cross entropy scaled by ``masked_lm_scale`` plus next-sentence loss.
+    """
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels,
+                masked_lm_scale=1.0):
+        """Reference semantics: ``sum(per-token CE over labels >= 0) /
+        masked_lm_scale + mean(NSP CE)`` — callers pass the masked-token
+        count as ``masked_lm_scale`` to get a mean (PaddleNLP pretraining
+        recipe); the default 1.0 yields the raw sum like the reference."""
+        labels = masked_lm_labels
+        if len(labels.shape) == 1:
+            labels = T.unsqueeze(labels, [-1])
+        elif labels.shape[-1] != 1:
+            labels = T.reshape(labels, [-1, 1])
+            prediction_scores = T.reshape(
+                prediction_scores, [-1, prediction_scores.shape[-1]])
+        valid = T.cast(T.greater_equal(
+            labels, T.full_like(labels, 0)), "float32")
+        safe_labels = T.multiply(labels, T.cast(valid, labels.dtype))
+        per_tok = F.softmax_with_cross_entropy(prediction_scores, safe_labels)
+        masked_lm_sum = T.sum(T.multiply(per_tok, valid))
+        masked_lm_loss = T.divide(
+            masked_lm_sum, T.full_like(masked_lm_sum, float(masked_lm_scale)))
+        nsp_labels = next_sentence_labels
+        if len(nsp_labels.shape) == 1:
+            nsp_labels = T.unsqueeze(nsp_labels, [-1])
+        nsp_loss = T.mean(F.softmax_with_cross_entropy(
+            seq_relationship_score, nsp_labels))
+        return T.add(masked_lm_loss, nsp_loss)

@@ -1,0 +1,14 @@
+"""Importable spawn target for test_launch.py::test_spawn_two_ranks (spawn
+start-method children must be able to pickle/re-import the function)."""
+
+import os
+import runpy
+import sys
+
+SCRIPT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "launch_train_script.py")
+
+
+def train(out_dir):
+    sys.argv = ["launch_train_script.py", out_dir]
+    runpy.run_path(SCRIPT, run_name="__main__")

@@ -1,0 +1,121 @@
+"""BERT pretraining heads/criterion + ViT (round-3 verdict item 5;
+BASELINE configs 1-2 runnable end to end)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import (
+    BertConfig, BertForPretraining, BertPretrainingCriterion,
+)
+from paddle_tpu.vision.models import VisionTransformer, vit_tiny
+
+
+def test_bert_pretraining_masked_positions_and_criterion():
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=32, dropout=0.0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion()
+    rng = np.random.RandomState(0)
+    b, s, m = 2, 16, 4
+    ids = rng.randint(0, 256, (b, s)).astype("int64")
+    pos = np.stack([rng.choice(s, m, replace=False) + i * s
+                    for i in range(b)]).astype("int64")
+    mlm_labels = ids.reshape(-1)[pos.reshape(-1)].astype("int64")
+    nsp_labels = rng.randint(0, 2, (b,)).astype("int64")
+
+    mlm_logits, nsp_logits = model(paddle.to_tensor(ids),
+                                   masked_positions=paddle.to_tensor(pos))
+    # gathered head: only |masked| rows hit the vocab matmul
+    assert mlm_logits.shape == [b * m, cfg.vocab_size]
+    assert nsp_logits.shape == [b, 2]
+    loss = crit(mlm_logits, nsp_logits, paddle.to_tensor(mlm_labels),
+                paddle.to_tensor(nsp_labels), masked_lm_scale=float(b * m))
+    assert np.isfinite(float(loss.numpy()))
+
+    # reference semantics: sum over valid labels / masked_lm_scale
+    # -> -1 labels contribute nothing
+    labels_ig = mlm_labels.copy()
+    labels_ig[1:] = -1
+    l_one = crit(mlm_logits, nsp_logits, paddle.to_tensor(labels_ig),
+                 paddle.to_tensor(nsp_labels))
+    only_first = crit(mlm_logits[:1], nsp_logits,
+                      paddle.to_tensor(mlm_labels[:1]),
+                      paddle.to_tensor(nsp_labels))
+    np.testing.assert_allclose(float(l_one.numpy()),
+                               float(only_first.numpy()), rtol=1e-5)
+
+    # full training: loss decreases
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    losses = []
+    for _ in range(6):
+        mlm_logits, nsp_logits = model(
+            paddle.to_tensor(ids), masked_positions=paddle.to_tensor(pos))
+        loss = crit(mlm_logits, nsp_logits, paddle.to_tensor(mlm_labels),
+                    paddle.to_tensor(nsp_labels),
+                    masked_lm_scale=float(b * m))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_vit_shapes_and_training():
+    paddle.seed(0)
+    model = vit_tiny(img_size=32, num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 32, 32)
+                         .astype("float32"))
+    out = model(x)
+    assert out.shape == [2, 10]
+    # features: cls token + (32/8)^2 patches
+    feats = model.forward_features(x)
+    assert feats.shape == [2, 17, 64]
+
+    crit = nn.CrossEntropyLoss()
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    y = paddle.to_tensor(np.array([[1], [7]], dtype="int64"))
+    losses = []
+    for _ in range(6):
+        loss = crit(model(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_vit_architectures_construct():
+    for ctor, dim in [(vit_tiny, 64)]:
+        m = ctor(img_size=32)
+        assert m.embed_dim == dim
+    big = VisionTransformer(img_size=64, patch_size=16, embed_dim=96,
+                            depth=1, num_heads=2, num_classes=5)
+    out = big(paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32")))
+    assert out.shape == [1, 5]
+
+
+def test_baseline_config_scripts():
+    """BASELINE configs 1-2 train end to end with decreasing loss."""
+    import runpy
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    argv = sys.argv
+    try:
+        sys.argv = ["pretrain_bert.py", "--steps", "6", "--hidden", "32",
+                    "--layers", "2", "--heads", "2", "--vocab", "128",
+                    "--seq", "32", "--batch", "2", "--masked", "4"]
+        runpy.run_path(os.path.join(repo, "examples", "pretrain_bert.py"),
+                       run_name="__main__")
+        sys.argv = ["train_vit.py", "--steps", "6", "--batch", "4",
+                    "--img", "16"]
+        runpy.run_path(os.path.join(repo, "examples", "train_vit.py"),
+                       run_name="__main__")
+    finally:
+        sys.argv = argv

@@ -175,11 +175,12 @@ binary("elementwise_mul", lambda x, y: x * y, bshape=(4,), tag="bcast")
 binary("elementwise_div", lambda x, y: x / y, "pos")
 binary("elementwise_max", lambda x, y: np.maximum(x, y))
 binary("elementwise_min", lambda x, y: np.minimum(x, y))
-binary("elementwise_pow", None, "pos", grad=())  # x>0 ref below
-case("elementwise_pow",
-     inputs={"X": R(7).uniform(0.5, 2, (3, 4)).astype("float32"),
-             "Y": R(8).uniform(0.5, 2, (3, 4)).astype("float32")},
-     refs={}, grad=("X", "Y"), tag="grad")
+_pw_x = R(7).uniform(0.5, 2, (3, 4)).astype("float32")
+_pw_y = R(8).uniform(0.5, 2, (3, 4)).astype("float32")
+case("elementwise_pow", inputs={"X": _pw_x, "Y": _pw_y},
+     refs={"Out": (_pw_x.astype(np.float64)
+                   ** _pw_y.astype(np.float64)).astype("float32")},
+     grad=("X", "Y"))
 binary("elementwise_mod", lambda x, y: np.mod(x, y), "pos", grad=())
 binary("elementwise_floordiv", lambda x, y: np.floor_divide(x, y), "pos",
        grad=())
@@ -231,7 +232,7 @@ case("mean", inputs={"X": xr}, refs={"Out": np.asarray(xr.mean(), "float32")},
 case("max", inputs={"X": xr}, refs={"Out": np.asarray(xr.max(), "float32")})
 case("sum", inputs={"X": [("sa", xr), ("sb", (xr * 2).astype("float32"))]},
      refs={"Out": (xr * 3)}, atol=1e-4)
-case("logsumexp" if False else "p_norm",
+case("p_norm",
      inputs={"X": xr}, attrs={"porder": 2.0, "axis": 1, "keepdim": False},
      refs={"Out": np.linalg.norm(xr.astype(np.float64), 2,
                                  axis=1).astype("float32")},
@@ -483,7 +484,7 @@ nchw = R(35).randn(2, 4, 3, 3).astype("float32")
 case("group_norm", inputs={"X": nchw,
                            "Scale": np.ones(4, "float32"),
                            "Bias": np.zeros(4, "float32")},
-     attrs={"epsilon": 1e-5, "groups": 2}, out="Y", grad=("X", "Scale")),
+     attrs={"epsilon": 1e-5, "groups": 2}, out="Y", grad=("X", "Scale"))
 case("instance_norm", inputs={"X": nchw,
                               "Scale": np.ones(4, "float32"),
                               "Bias": np.zeros(4, "float32")},
@@ -612,20 +613,37 @@ case("adagrad", inputs={"Param": p0, "Grad": g0, "Moment": m0,
      refs={"MomentOut": g0 ** 2,
            "ParamOut": p0 - 0.1 * g0 / (np.sqrt(g0 ** 2) + 1e-6)},
      atol=1e-4)
+# lamb: m-hat = g0 (zero moments, b1p=beta1), trust ratio ||p||/||r||
+_r = g0 / (np.abs(g0) + 1e-6) + 0.01 * p0
+_ratio = np.linalg.norm(p0) / np.linalg.norm(_r)
 case("lamb", inputs={"Param": p0, "Grad": g0, "Moment1": m0, "Moment2": m0,
                      "LearningRate": lr0, "Beta1Pow": b1p, "Beta2Pow": b2p},
      attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
             "weight_decay": 0.01},
-     out="ParamOut")
+     out="ParamOut",
+     refs={"ParamOut": (p0 - _ratio * 0.1 * _r).astype("float32"),
+           "Moment1Out": 0.1 * g0, "Moment2Out": 0.001 * g0 ** 2},
+     atol=1e-4)
+_ms = 0.9 * 1.0 + 0.1 * g0 ** 2
+_mom = 0.1 * g0 / np.sqrt(_ms + 1e-6)
 case("rmsprop", inputs={"Param": p0, "Grad": g0, "Moment": m0,
                         "MeanSquare": np.ones(4, "float32"),
                         "MeanGrad": m0, "LearningRate": lr0},
      attrs={"decay": 0.9, "epsilon": 1e-6, "momentum": 0.0},
-     out="ParamOut")
+     out="ParamOut",
+     refs={"ParamOut": (p0 - _mom).astype("float32"),
+           "MeanSquareOut": _ms.astype("float32")},
+     atol=1e-4)
+_llr = 0.1 * 0.001 * np.linalg.norm(p0) / (
+    np.linalg.norm(g0) + 0.0005 * np.linalg.norm(p0))
+_vout = 0.9 * v0 + _llr * (g0 + 0.0005 * p0)
 case("lars_momentum", inputs={"Param": p0, "Grad": g0, "Velocity": v0,
                               "LearningRate": lr0},
      attrs={"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005},
-     out="ParamOut")
+     out="ParamOut",
+     refs={"ParamOut": (p0 - _vout).astype("float32"),
+           "VelocityOut": _vout.astype("float32")},
+     atol=1e-4)
 sc = np.array([2.0], "float32")
 case("check_finite_and_unscale",
      inputs={"X": [("cfx", ma)], "Scale": sc},
@@ -711,6 +729,7 @@ def test_op_case(c):
                                        atol=c.atol, rtol=c.rtol,
                                        err_msg=f"{c.op} output {slot}")
         return
+    assert c.refs or c.grad, f"vacuous case for {c.op}: no refs and no grad"
     t = _SweepTest(c)
     # build output slot map: refs keyed by var name when override given
     if c.outputs_override:
