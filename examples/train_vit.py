@@ -35,12 +35,7 @@ def main():
 
     paddle.seed(args.seed)
     ctor = getattr(vm, args.arch)
-    kw = {"num_classes": args.classes}
-    if args.arch != "vit_tiny":
-        kw["img_size"] = args.img
-    else:
-        kw["img_size"] = args.img
-    model = ctor(**kw)
+    model = ctor(num_classes=args.classes, img_size=args.img)
     criterion = nn.CrossEntropyLoss()
     optimizer = opt.AdamW(learning_rate=args.lr,
                           parameters=model.parameters(), weight_decay=0.05,

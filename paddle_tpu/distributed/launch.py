@@ -59,7 +59,9 @@ def get_cluster_from_args(args) -> tuple:
     nproc = args.nproc_per_node or (len(devices) if devices else 1)
     if args.master:
         host, _, port = args.master.partition(":")
-        master, master_port = host, int(port or find_free_port())
+        # bare host: every NODE must agree on the port, so use the fixed
+        # default — a per-node find_free_port() could never rendezvous
+        master, master_port = host, int(port or 8476)
     else:
         master = ips[0]
         master_port = find_free_port() if ips == ["127.0.0.1"] else 8476

@@ -89,6 +89,11 @@ def start_local_trainers(cluster: Cluster, cmd: List[str],
                          log_dir: Optional[str] = None,
                          devices: Optional[List[str]] = None
                          ) -> List[TrainerProc]:
+    if devices and len(devices) < cluster.nproc_per_node:
+        raise ValueError(
+            f"--devices lists {len(devices)} device id(s) but "
+            f"nproc_per_node={cluster.nproc_per_node}; provide one id per "
+            f"local trainer")
     procs = []
     for rank in cluster.local_ranks():
         env = dict(base_env if base_env is not None else os.environ)

@@ -25,14 +25,28 @@ class MultiprocessContext:
         self.error_queues = error_queues
 
     def join(self, timeout: Optional[float] = None) -> bool:
-        for p in self.processes:
-            p.join(timeout)
-        failed = [(i, p.exitcode) for i, p in enumerate(self.processes)
-                  if p.exitcode not in (0, None)]
+        """Wait for ALL ranks concurrently; terminate the pool on the first
+        failure (a serial per-rank join would deadlock when a crashed later
+        rank leaves an earlier rank blocked in a collective)."""
+        import time
+
+        deadline = time.time() + timeout if timeout else None
+        failed = []
+        while True:
+            alive = [p for p in self.processes if p.exitcode is None]
+            failed = [(i, p.exitcode) for i, p in enumerate(self.processes)
+                      if p.exitcode not in (0, None)]
+            if failed or not alive:
+                break
+            if deadline and time.time() > deadline:
+                return False
+            time.sleep(0.1)
         if failed:
             for p in self.processes:
                 if p.is_alive():
                     p.terminate()
+            for p in self.processes:
+                p.join(10)
             msgs = []
             for i, code in failed:
                 err = ""
@@ -43,7 +57,7 @@ class MultiprocessContext:
                     pass
                 msgs.append(f"rank {i} exited with code {code}\n{err}")
             raise RuntimeError("spawn: trainer failure:\n" + "\n".join(msgs))
-        return all(p.exitcode is not None for p in self.processes)
+        return True
 
 
 def _worker(func, args, env, error_queue):

@@ -42,7 +42,8 @@ def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False,
             logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and rng is not None:
-        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, probs.shape)
+        keep = jax.random.uniform(
+            rng, probs.shape, dtype=jnp.float32) < jnp.float32(1.0 - dropout_p)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), jnp.zeros_like(probs))
     return jnp.einsum("...qk,...kd->...qd", probs, v)
 

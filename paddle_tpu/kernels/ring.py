@@ -1,0 +1,130 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+SURVEY.md §5 names long-context ring attention a fresh-design mandate (the
+reference has no equivalent — its sequence length is bounded by one GPU's
+memory).  Design (Liu et al., "Ring Attention with Blockwise Transformers
+for Near-Infinite Context", 2023):
+
+  * Q, K, V are sharded over the sequence dim on a mesh axis; each device
+    keeps its Q shard resident and STREAMS the K/V shards around the ring
+    via ``lax.ppermute`` over ICI;
+  * each ring step computes blockwise attention of the local Q against the
+    visiting K/V block and folds it into an online-softmax accumulator
+    (running max m, normalizer l, unnormalized output o) — the same math
+    as the Pallas flash kernel's inner loop (kernels/flash.py), lifted one
+    level up so the *sequence axis* scales with the number of devices;
+  * XLA overlaps the ppermute with the next block's compute inside the
+    ``lax.scan`` (compute/comm overlap the paper schedules by hand);
+  * causal masking uses GLOBAL positions (device i's Q rows are offset by
+    i*S_local), so fully-masked visiting blocks contribute zero.
+
+Peak memory per device is O(S/P * S/P) for one score block instead of
+O(S^2): sequence length scales linearly with the ring size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..distributed import mesh as mesh_mod
+
+NEG_INF = -1e30
+
+
+def _ring_block(q, k, v, o, m, l, q_off, kv_off, scale, causal):
+    """Fold one visiting K/V block into the online-softmax accumulator.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D); o: like q (unnormalized);
+    m/l: (B, H, Sq) running max / normalizer.  Offsets are the blocks'
+    global sequence positions (traced scalars).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[-2])
+        kv_pos = kv_off + jnp.arange(k.shape[-2])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (m_new == NEG_INF): keep them at zero weight
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+    l_new = alpha * l + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(p.dtype))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention over sequence-sharded Q/K/V (global arrays, (B, H, S, D)).
+
+    The sequence dim is (re)sharded over ``axis``; returns the global
+    output with the same sharding.  Equivalent to
+    ``softmax(QK^T * scale [+causal mask]) V`` computed without any device
+    ever holding the full sequence.
+    """
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        from .attention import _sdpa_reference
+
+        return _sdpa_reference(q, k, v, scale=scale, is_causal=causal)
+    ring = int(mesh.shape[axis])
+    b, h, s, d = q.shape
+    if s % ring:
+        raise ValueError(f"seq len {s} must divide the ring size {ring}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s_local = s // ring
+
+    spec = P(None, None, axis, None)
+    sharded = NamedSharding(mesh, spec)
+    q = jax.device_put(jnp.asarray(q), sharded)
+    k = jax.device_put(jnp.asarray(k), sharded)
+    v = jax.device_put(jnp.asarray(v), sharded)
+
+    def per_device(ql, kl, vl):
+        i = lax.axis_index(axis)
+        q_off = i * s_local
+        o = jnp.zeros(ql.shape[:3] + (vl.shape[-1],), jnp.float32)
+        m = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+        l = jnp.zeros(ql.shape[:3], jnp.float32)
+        perm = [(src, (src + 1) % ring) for src in range(ring)]
+
+        def step(carry, r):
+            o, m, l, k_r, v_r = carry
+            kv_off = ((i - r) % ring) * s_local
+            o, m, l = _ring_block(ql, k_r, v_r, o, m, l, q_off, kv_off,
+                                  scale, causal)
+            # rotate AFTER using the block; XLA overlaps this ppermute with
+            # the next iteration's einsum
+            k_r = lax.ppermute(k_r, axis, perm)
+            v_r = lax.ppermute(v_r, axis, perm)
+            return (o, m, l, k_r, v_r), None
+
+        (o, m, l, _, _), _ = lax.scan(step, (o, m, l, kl, vl),
+                                      jnp.arange(ring))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (o / l[..., None]).astype(ql.dtype)
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    try:
+        fn = shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:  # pragma: no cover - older shard_map signature
+        fn = shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return fn(q, k, v)

@@ -445,7 +445,8 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
             xc, lc = inp
             logits = jnp.dot(xc, w.T, preferred_element_type=jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
-            picked = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+            picked = jnp.take_along_axis(logits, lc[:, None], axis=-1,
+                                         mode="clip")[:, 0]
             return tot + jnp.sum(lse - picked), None
 
         tot, _ = lax.scan(
@@ -463,7 +464,8 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
         logits = jnp.matmul(x, w.T)
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(
-            logits.astype(jnp.float32), labels[..., None], axis=-1)[..., 0]
+            logits.astype(jnp.float32), labels[..., None], axis=-1,
+            mode="clip")[..., 0]
         return jnp.mean(lse - picked)
 
     params_tree = (other, stacked)

@@ -30,6 +30,7 @@ __all__ = [
     "l1_loss", "nll_loss", "kl_div", "smooth_l1_loss", "margin_ranking_loss",
     "pad", "interpolate", "upsample", "unfold", "flatten", "label_smooth",
     "normalize", "cosine_similarity", "scaled_dot_product_attention",
+    "ring_attention",
     "sequence_mask", "square_error_cost", "accuracy",
 ]
 
@@ -603,3 +604,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
         is_causal=is_causal, training=training,
     )
+
+
+def ring_attention(query, key, value, axis="mp", is_causal=False, name=None):
+    """Sequence-parallel attention over a mesh axis (kernels/ring.py):
+    Q/K/V sequence-sharded, K/V streamed around the ICI ring via ppermute.
+    Beyond-parity long-context path (SURVEY §5); inputs/outputs are
+    (B, H, S, D) Tensors, output sequence-sharded like the inputs.
+    Differentiable (vjp through the shard_map ring)."""
+    from ...kernels.ring import ring_attention as _ring
+
+    from ...dygraph import tracer
+
+    def fn(q, k, v):
+        return _ring(q, k, v, axis=axis, causal=is_causal)
+
+    return tracer.trace_fn(fn, [query, key, value], name="ring_attention")

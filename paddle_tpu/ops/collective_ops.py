@@ -334,7 +334,7 @@ def c_softmax_with_cross_entropy_kernel(ins, attrs):
     local = lab - start
     in_range = (local >= 0) & (local < vocab_local)
     safe = jnp.clip(local, 0, vocab_local - 1)
-    picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1)
+    picked = jnp.take_along_axis(shifted, safe[..., None], axis=-1, mode="clip")
     picked = jnp.where(in_range[..., None], picked, jnp.zeros_like(picked))
     if _active(axis):
         picked = lax.psum(picked, axis)

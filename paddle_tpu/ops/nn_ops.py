@@ -388,7 +388,9 @@ def dropout_kernel(ins, attrs, rng=None):
         )
     else:
         mask_shape = x.shape
-    keep = jax.random.bernoulli(rng, 1.0 - p, mask_shape)
+    # explicit f32 draw: jax.random.bernoulli defaults to the x64 float
+    # dtype, silently generating the whole mask computation in f64
+    keep = jax.random.uniform(rng, mask_shape, dtype=jnp.float32) < jnp.float32(1.0 - p)
     keep = jnp.broadcast_to(keep, x.shape)
     if impl == "upscale_in_train":
         scale = 0.0 if p >= 1.0 else 1.0 / (1.0 - p)
@@ -461,7 +463,7 @@ def softmax_with_cross_entropy_kernel(ins, attrs):
         # gather through a safe index to avoid negative-index wraparound
         valid = lab != ignore_index
         safe_lab = jnp.where(valid, lab, jnp.zeros_like(lab))
-        picked = jnp.take_along_axis(log_softmax, jnp.expand_dims(safe_lab, axis), axis=axis)
+        picked = jnp.take_along_axis(log_softmax, jnp.expand_dims(safe_lab, axis), axis=axis, mode="clip")
         loss = jnp.where(jnp.expand_dims(valid, axis), -picked, jnp.zeros_like(picked))
     return {"Softmax": softmax, "Loss": loss.astype(logits.dtype)}
 
@@ -497,7 +499,7 @@ def cross_entropy_kernel(ins, attrs):
         lab = label
         if lab.ndim == x.ndim:
             lab = jnp.squeeze(lab, -1)
-        picked = jnp.take_along_axis(x, jnp.expand_dims(lab, -1), axis=-1)
+        picked = jnp.take_along_axis(x, jnp.expand_dims(lab, -1), axis=-1, mode="clip")
         loss = -jnp.log(jnp.clip(picked, 1e-12))
     return {"Y": loss}
 

@@ -93,7 +93,9 @@ def randperm_kernel(ins, attrs, rng=None):
 @register_op("bernoulli", needs_rng=True, nondiff_slots=("X",), no_grad=True)
 def bernoulli_kernel(ins, attrs, rng=None):
     x = ins["X"]
-    return {"Out": jax.random.bernoulli(rng, x).astype(x.dtype)}
+    # f32 draw (bernoulli would use the x64 default float dtype)
+    u = jax.random.uniform(rng, x.shape, dtype=jnp.float32)
+    return {"Out": (u < x.astype(jnp.float32)).astype(x.dtype)}
 
 
 @register_op("range", no_grad=True)

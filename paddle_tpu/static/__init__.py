@@ -16,4 +16,5 @@ from .executor import CompiledProgram, Executor  # noqa: F401
 from .io import load, load_inference_model, save, save_inference_model  # noqa: F401
 from .input import data, InputSpec  # noqa: F401
 from . import nn  # noqa: F401
+from . import amp  # noqa: F401
 from .control_flow import cond, while_loop  # noqa: F401

@@ -1,0 +1,217 @@
+"""Static-graph automatic mixed precision: program-rewriting bf16 casts.
+
+Parity: ``/root/reference/python/paddle/fluid/contrib/mixed_precision/
+decorator.py:1`` (``decorate`` -> OptimizerWithMixedPrecision) and
+``fp16_utils.py`` (``rewrite_program``: white/black list walk inserting
+cast ops; ``cast_model_to_fp16``).
+
+TPU-first: the payoff dtype is **bfloat16** (MXU native; no loss scaling
+needed — bf16 has fp32's exponent range, so the reference's
+found_inf/loss-scaling machinery is unnecessary on this path, though
+``decorate`` keeps the arg surface).  Parameters stay fp32 in the scope
+(master weights by construction); casts are inserted per-use ahead of
+white-list ops, so the optimizer update runs full precision — the
+``multi_precision`` interplay the dygraph O2 path implements with explicit
+master copies.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from ..framework import program as fw
+
+__all__ = [
+    "AutoMixedPrecisionLists",
+    "rewrite_program",
+    "cast_model_to_bf16",
+    "decorate",
+    "bf16_guard",
+]
+
+
+class AutoMixedPrecisionLists:
+    """Parity: fp16_lists.py AutoMixedPrecisionLists — three-way op split.
+
+    white: numerically safe AND MXU-profitable (run in bf16);
+    black: numerically sensitive (forced fp32);
+    gray: follow their inputs.
+    """
+
+    _DEFAULT_WHITE = {
+        "matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d",
+        "conv2d_transpose", "addmm",
+    }
+    _DEFAULT_BLACK = {
+        "softmax_with_cross_entropy", "cross_entropy",
+        "sigmoid_cross_entropy_with_logits", "bce_loss", "c_softmax_with_cross_entropy",
+        "mean", "reduce_mean", "reduce_sum", "sum",
+        "exp", "log", "log2", "log10", "log1p", "rsqrt", "pow",
+        "square", "squared_l2_norm", "p_norm", "norm", "cumsum",
+        "softmax", "log_softmax", "layer_norm", "batch_norm",
+        "group_norm", "instance_norm",
+    }
+
+    def __init__(self, custom_white_list: Optional[Set[str]] = None,
+                 custom_black_list: Optional[Set[str]] = None,
+                 custom_black_varnames: Optional[Set[str]] = None):
+        self.white_list = set(self._DEFAULT_WHITE)
+        self.black_list = set(self._DEFAULT_BLACK)
+        self.black_varnames = set(custom_black_varnames or ())
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
+
+
+_FLOAT_DTYPES = {"float32", "float64"}
+
+
+def _is_float_var(block, name):
+    try:
+        var = block._var_recursive(name)
+    except Exception:
+        return False
+    return str(getattr(var, "dtype", "")) in _FLOAT_DTYPES | {"bfloat16",
+                                                              "float16"}
+
+
+def _insert_cast(block, new_ops, cache, name, dest, src_dtype):
+    """Append a cast op producing ``name.cast_<dest>`` (memoized)."""
+    key = (name, dest)
+    if key in cache:
+        return cache[key]
+    out = f"{name}.cast_{dest}"
+    if out not in block.vars:
+        src = block._var_recursive(name)
+        block.create_var(name=out, shape=getattr(src, "shape", None),
+                         dtype=dest)
+    op = fw.Operator(block, "cast", inputs={"X": [name]},
+                     outputs={"Out": [out]},
+                     attrs={"in_dtype": src_dtype, "out_dtype": dest})
+    new_ops.append(op)
+    cache[key] = out
+    return out
+
+
+def rewrite_program(main_program, amp_lists: Optional[AutoMixedPrecisionLists]
+                    = None, dest_dtype: str = "bfloat16"):
+    """Parity: fp16_utils.rewrite_program — walk the global block, cast
+    float inputs of white-list ops to ``dest_dtype`` and inputs of
+    black-list ops back to fp32.  Gray ops run in whatever dtype reaches
+    them (XLA type-propagates; outputs follow jnp promotion, so a gray
+    elementwise op over bf16 inputs stays bf16)."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    block = main_program.global_block()
+    new_ops = []
+    cache = {}
+    low_vars = set()  # vars known to be dest_dtype at runtime
+    for op in list(block.ops):
+        if op.type in ("cast", "feed", "fetch"):
+            new_ops.append(op)
+            continue
+        if op.type in amp_lists.white_list and not (
+                amp_lists.black_varnames
+                and any(n in amp_lists.black_varnames
+                        for ns in op.outputs.values() for n in ns)):
+            for slot, names in op.inputs.items():
+                for i, n in enumerate(names):
+                    if not _is_float_var(block, n) or n in low_vars:
+                        continue
+                    names[i] = _insert_cast(block, new_ops, cache, n,
+                                            dest_dtype, "float32")
+            new_ops.append(op)
+            for ns in op.outputs.values():
+                low_vars.update(ns)
+        elif op.type in amp_lists.black_list:
+            for slot, names in op.inputs.items():
+                for i, n in enumerate(names):
+                    if n in low_vars:
+                        names[i] = _insert_cast(block, new_ops, cache, n,
+                                                "float32", dest_dtype)
+            new_ops.append(op)
+        else:
+            # gray: propagate low precision through elementwise/shape ops
+            new_ops.append(op)
+            if any(n in low_vars
+                   for ns in op.inputs.values() for n in ns):
+                for ns in op.outputs.values():
+                    low_vars.update(ns)
+    block.ops = new_ops
+    return main_program
+
+
+# reference alias (cast_model_to_fp16 role, bf16 flavor)
+def cast_model_to_bf16(program, amp_lists=None):
+    return rewrite_program(program, amp_lists, dest_dtype="bfloat16")
+
+
+class _BF16GuardCtx:
+    enabled = False
+
+
+class bf16_guard:
+    """Parity role: fp16_utils fp16_guard — scope marker; ops built inside
+    are eligible for the white list rewrite (here: all ops are eligible by
+    default, the guard is accepted for API compatibility)."""
+
+    def __enter__(self):
+        _BF16GuardCtx.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _BF16GuardCtx.enabled = False
+        return False
+
+
+class OptimizerWithMixedPrecision:
+    """Parity: decorator.py OptimizerWithMixedPrecision — wraps minimize:
+    rewrite forward program to bf16, then build backward + optimize ops on
+    the rewritten graph (grads of casts are casts back, so param grads and
+    updates stay fp32 = master weights)."""
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=1.0,
+                 use_dynamic_loss_scaling=False, dest_dtype="bfloat16"):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._dest_dtype = dest_dtype
+        # bf16 needs no loss scaling (fp32 exponent range); args accepted
+        # for reference API compatibility
+        self._loss_scaling = init_loss_scaling
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_bf16_test=False):
+        if test_program is not None:
+            rewrite_program(test_program, self._amp_lists, self._dest_dtype)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        rewrite_program(program, self._amp_lists, self._dest_dtype)
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program, parameters=parameters,
+            no_grad_set=no_grad_set)
+
+    def backward(self, loss, **kw):
+        rewrite_program(loss.block.program, self._amp_lists, self._dest_dtype)
+        return self._optimizer.backward(loss, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             incr_every_n_steps=None, decr_every_n_nan_or_inf=None,
+             incr_ratio=None, decr_ratio=None,
+             use_dynamic_loss_scaling=False, use_pure_bf16=False,
+             use_bf16_guard=None):
+    """Parity: decorator.py decorate:1 — returns the wrapped optimizer."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling)

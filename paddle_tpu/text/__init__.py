@@ -1,0 +1,20 @@
+"""``paddle.text`` — NLP datasets.
+
+Parity: ``/root/reference/python/paddle/text/__init__.py`` (datasets:
+Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, Conll05st).
+"""
+
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+    "WMT14", "WMT16",
+]

@@ -1,0 +1,100 @@
+"""Ring attention (sequence parallelism over a mesh axis) vs single-device
+attention — SURVEY §5 long-context mandate, round-3 verdict item 9."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.kernels.attention import _sdpa_reference
+from paddle_tpu.kernels.ring import ring_attention
+
+
+def _init(mp=8):
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": mp, "pp_degree": 1, "sharding_degree": 1,
+    }
+    fleet.init(is_collective=True, strategy=s)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_matches_single_device(causal):
+    _init(mp=8)
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 3, 64, 16
+    q = rng.randn(b, h, s, d).astype("float32")
+    k = rng.randn(b, h, s, d).astype("float32")
+    v = rng.randn(b, h, s, d).astype("float32")
+
+    out = np.asarray(ring_attention(q, k, v, axis="mp", causal=causal))
+    ref = np.asarray(_sdpa_reference(q, k, v, is_causal=causal))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_long_sequence_sharded():
+    """8k tokens partitioned over 8 devices — each device only ever holds
+    1k keys at a time (the long-context scaling point)."""
+    _init(mp=8)
+    rng = np.random.RandomState(1)
+    b, h, s, d = 1, 2, 8192, 8
+    q = rng.randn(b, h, s, d).astype("float32")
+    k = rng.randn(b, h, s, d).astype("float32")
+    v = rng.randn(b, h, s, d).astype("float32")
+    out = ring_attention(q, k, v, axis="mp", causal=True)
+    # output stays sequence-sharded over the ring axis
+    spec = out.sharding.spec
+    flat = [x for xs in spec for x in (xs if isinstance(xs, tuple) else [xs])]
+    assert "mp" in flat
+    arr = np.asarray(out)
+    assert arr.shape == (b, h, s, d)
+    assert np.isfinite(arr).all()
+    # spot-check rows against the reference on a slice (full ref is O(S^2))
+    ref_head = np.asarray(_sdpa_reference(
+        q[:, :, :256], k[:, :, :256], v[:, :, :256], is_causal=True))
+    np.testing.assert_allclose(arr[:, :, :256], ref_head, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_ring_functional_surface_differentiable():
+    """F.ring_attention works on Tensors and backprops through the ring."""
+    _init(mp=8)
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(3)
+    b, h, s, d = 1, 2, 32, 8
+    q = paddle.to_tensor(rng.randn(b, h, s, d).astype("float32"),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.randn(b, h, s, d).astype("float32"),
+                         stop_gradient=False)
+    v = paddle.to_tensor(rng.randn(b, h, s, d).astype("float32"),
+                         stop_gradient=False)
+    out = F.ring_attention(q, k, v, axis="mp", is_causal=True)
+    assert out.shape == [b, h, s, d]
+    out.sum().backward()
+    for t in (q, k, v):
+        assert t.grad is not None
+        assert np.isfinite(np.asarray(t.grad._array)).all()
+    # grads match the reference attention's grads
+    import jax
+
+    def ref_loss(qa, ka, va):
+        return _sdpa_reference(qa, ka, va, is_causal=True).sum()
+
+    gq, gk, gv = jax.grad(ref_loss, argnums=(0, 1, 2))(
+        np.asarray(q._array), np.asarray(k._array), np.asarray(v._array))
+    np.testing.assert_allclose(np.asarray(q.grad._array), gq, rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(k.grad._array), gk, rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(v.grad._array), gv, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_ring_falls_back_without_mesh_axis():
+    _init(mp=1)  # no usable ring axis
+    rng = np.random.RandomState(2)
+    q = rng.randn(1, 2, 16, 8).astype("float32")
+    out = np.asarray(ring_attention(q, q, q, axis="mp", causal=False))
+    ref = np.asarray(_sdpa_reference(q, q, q, is_causal=False))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,96 @@
+"""Static-graph AMP: program-rewriting bf16 casts (round-3 verdict item 8).
+
+Parity: ``fluid/contrib/mixed_precision/{decorator,fp16_utils}.py`` — a
+static training step runs its matmuls in bf16 while losses/updates stay
+fp32, with loss parity vs the fp32 program within bf16 tolerance."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu import nn, optimizer as opt
+
+
+def _build_mlp(main, startup, in_dim=8, hidden=16):
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, in_dim], "float32")
+        y = static.data("y", [None, 1], "float32")
+        h = nn.functional.relu(static.nn.fc(x, hidden))
+        pred = static.nn.fc(h, 1)
+        loss = paddle.mean(nn.functional.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_rewrite_program_inserts_casts():
+    main, startup = static.Program(), static.Program()
+    _build_mlp(main, startup)
+    n_ops = len(main.global_block().ops)
+    static.amp.rewrite_program(main)
+    ops = main.global_block().ops
+    casts = [o for o in ops if o.type == "cast"]
+    # two fc matmuls: each gets input + weight casts to bf16; the black-list
+    # mean/square path casts back to fp32
+    assert len(casts) >= 3, [o.type for o in ops]
+    assert len(ops) > n_ops
+    to_bf16 = [o for o in casts if o.attrs.get("out_dtype") == "bfloat16"]
+    to_fp32 = [o for o in casts if o.attrs.get("out_dtype") == "float32"]
+    assert to_bf16 and to_fp32
+    # the matmul now consumes casted inputs
+    mm = next(o for o in ops if o.type in ("matmul_v2", "mul", "matmul"))
+    assert any(n.endswith(".cast_bfloat16")
+               for ns in mm.inputs.values() for n in ns)
+
+
+def test_decorated_training_matches_fp32():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8).astype("float32")
+    ys = (xs.sum(1, keepdims=True) * 0.3).astype("float32")
+
+    def train(use_amp):
+        paddle.seed(0)
+        main, startup = static.Program(), static.Program()
+        x, y, pred, loss = _build_mlp(main, startup)
+        with static.program_guard(main, startup):
+            sgd = opt.SGD(learning_rate=0.1)
+            if use_amp:
+                sgd = static.amp.decorate(sgd)
+            sgd.minimize(loss)
+        exe = static.Executor()
+        scope = static.global_scope() if False else None
+        from paddle_tpu.framework.scope import Scope
+
+        sc = Scope()
+        exe.run(startup, scope=sc)
+        losses = []
+        for _ in range(10):
+            (l,) = exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[loss], scope=sc)
+            losses.append(float(l))
+        return losses
+
+    fp32 = train(False)
+    bf16 = train(True)
+    assert all(np.isfinite(bf16))
+    assert bf16[-1] < bf16[0]  # training works
+    # bf16 has ~8 mantissa bits: losses track fp32 within percent-level
+    np.testing.assert_allclose(bf16, fp32, rtol=0.05, atol=0.05)
+
+
+def test_black_varnames_and_custom_lists():
+    lists = static.amp.AutoMixedPrecisionLists(
+        custom_black_list={"matmul_v2", "mul", "matmul"})
+    main, startup = static.Program(), static.Program()
+    _build_mlp(main, startup)
+    static.amp.rewrite_program(main, lists)
+    # nothing white-listed anymore: no bf16 casts inserted
+    casts = [o for o in main.global_block().ops if o.type == "cast"
+             and o.attrs.get("out_dtype") == "bfloat16"]
+    assert not casts

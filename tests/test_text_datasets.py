@@ -1,0 +1,170 @@
+"""paddle.text datasets: local-archive parsers in the reference formats
+(round-3 verdict item 10 remainder).  Each test synthesizes a tiny archive
+in the EXACT on-disk format the reference downloads, then checks parsing."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+
+
+def _tar_add(tf, name, content: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(content)
+    tf.addfile(info, io.BytesIO(content))
+
+
+def test_zero_egress_guidance():
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        Imdb()
+    with pytest.raises(RuntimeError, match="data_file"):
+        UCIHousing()
+
+
+def test_imdb(tmp_path):
+    p = str(tmp_path / "aclImdb.tar")
+    with tarfile.open(p, "w") as tf:
+        docs = {
+            "aclImdb/train/pos/0.txt": b"good good movie!",
+            "aclImdb/train/neg/0.txt": b"bad bad movie.",
+            "aclImdb/test/pos/0.txt": b"good movie",
+            "aclImdb/test/neg/0.txt": b"bad movie",
+        }
+        for name, text in docs.items():
+            _tar_add(tf, name, text)
+    ds = Imdb(data_file=p, mode="train", cutoff=1)
+    # words with freq > 1: good(3), bad(3), movie(4) -> dict + <unk>
+    assert len(ds.word_idx) == 4
+    assert len(ds) == 2
+    doc, label = ds[0]
+    assert label[0] == 0  # pos first
+    assert doc.dtype.kind == "i" or doc.dtype.kind == "u" or doc.dtype == int
+    test = Imdb(data_file=p, mode="test", cutoff=1)
+    assert len(test) == 2
+
+
+def test_imikolov(tmp_path):
+    p = str(tmp_path / "simple-examples.tgz")
+    train = b"the cat sat\nthe dog sat\n"
+    valid = b"the cat ran\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "./simple-examples/data/ptb.train.txt", train)
+        _tar_add(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    ds = Imikolov(data_file=p, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=1)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert len(gram) == 2
+    seq = Imikolov(data_file=p, data_type="SEQ", mode="test",
+                   min_word_freq=1)
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+
+
+def test_uci_housing(tmp_path):
+    p = str(tmp_path / "housing.data")
+    rng = np.random.RandomState(0)
+    data = rng.rand(20, 14)
+    np.savetxt(p, data)
+    train = UCIHousing(data_file=p, mode="train")
+    test = UCIHousing(data_file=p, mode="test")
+    assert len(train) == 16 and len(test) == 4
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.dtype == np.float32
+
+
+def test_movielens(tmp_path):
+    p = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Comedy\n"
+                    "2::Jumanji (1995)::Adventure\n")
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::4::12345\n2::F::35::7::54321\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::1::5::978300760\n1::2::3::978302109\n"
+                    "2::1::4::978301968\n")
+    ds = Movielens(data_file=p, mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    item = ds[0]
+    # (uid, gender, age, job, mid, categories, title_ids, rating)
+    assert len(item) == 8
+    assert float(item[-1]) in (3.0, 4.0, 5.0)
+
+
+def _wmt14_archive(path):
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "wmt14/src.dict", b"<s>\n<e>\n<unk>\nhello\nworld\n")
+        _tar_add(tf, "wmt14/trg.dict", b"<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+        _tar_add(tf, "wmt14/train/train",
+                 b"hello world\tbonjour monde\nhello\tbonjour\n")
+        _tar_add(tf, "wmt14/test/test", b"world\tmonde\n")
+
+
+def test_wmt14(tmp_path):
+    p = str(tmp_path / "wmt14.tgz")
+    _wmt14_archive(p)
+    ds = WMT14(data_file=p, mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert trg[0] == ds.trg_dict["<s>"]
+    assert trg_next[-1] == ds.trg_dict["<e>"]
+    assert len(trg) == len(trg_next)
+    assert len(WMT14(data_file=p, mode="test", dict_size=5)) == 1
+
+
+def test_wmt16(tmp_path):
+    p = str(tmp_path / "wmt16.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "wmt16/train",
+                 b"a small dog\tein kleiner hund\nthe dog\tder hund\n")
+        _tar_add(tf, "wmt16/val", b"a dog\tein hund\n")
+        _tar_add(tf, "wmt16/test", b"the small dog\tder kleine hund\n")
+    ds = WMT16(data_file=p, mode="train", lang="en")
+    assert len(ds) == 2
+    src, trg, trg_next = ds[1]
+    assert src[0] == ds.src_dict["<s>"]
+    # "hund" is in the target dict built from the train de column
+    assert "hund" in ds.trg_dict
+    assert len(WMT16(data_file=p, mode="val", lang="en")) == 1
+
+
+def test_conll05(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    props = b"-\t*\n-\t(A0*)\nsit\t(V*)\n\n"
+    buf_w, buf_p = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=buf_w, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=buf_p, mode="w") as g:
+        g.write(props)
+    p = str(tmp_path / "conll05st-tests.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 buf_w.getvalue())
+        _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 buf_p.getvalue())
+    wd = str(tmp_path / "wordDict.txt")
+    vd = str(tmp_path / "verbDict.txt")
+    td = str(tmp_path / "targetDict.txt")
+    open(wd, "w").write("The\ncat\nsat\n<unk>\n")
+    open(vd, "w").write("sit\n")
+    open(td, "w").write("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    ds = Conll05st(data_file=p, word_dict_file=wd, verb_dict_file=vd,
+                   target_dict_file=td, emb_file=td)
+    assert len(ds) == 1
+    item = ds[0]
+    assert len(item) == 9  # 9-slot SRL tuple
+    word_ids, *ctxs, pred, mark, label_ids = item
+    assert len(word_ids) == 3 and len(label_ids) == 3
+    assert mark.sum() == 1
