@@ -30,7 +30,7 @@ class MultiprocessContext:
         rank leaves an earlier rank blocked in a collective)."""
         import time
 
-        deadline = time.time() + timeout if timeout else None
+        deadline = time.time() + timeout if timeout is not None else None
         failed = []
         while True:
             alive = [p for p in self.processes if p.exitcode is None]

@@ -55,8 +55,7 @@ def _ring_block(q, k, v, o, m, l, q_off, kv_off, scale, causal):
     m_new = jnp.maximum(m, m_blk)
     # guard fully-masked rows (m_new == NEG_INF): keep them at zero weight
     m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    p = jnp.exp(s - m_safe[..., None])  # masked scores underflow to 0
     alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
     l_new = alpha * l + jnp.sum(p, axis=-1)
     o_new = o * alpha[..., None] + jnp.einsum(

@@ -250,7 +250,7 @@ def GPTForPretrainingPipe(cfg: GPTConfig, num_stages: Optional[int] = None,
 
 def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                                 beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
-                                dp_axis="dp", remat: bool = True,
+                                dp_axis="dp", remat=True,
                                 ce_chunk_rows: int = 1024,
                                 sharding_stage: Optional[int] = None):
     """Compile fwd+bwd+AdamW into ONE donated XLA program over the hybrid mesh.
@@ -264,8 +264,10 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
     `fleet/meta_parallel/pipeline_parallel.py:114` reaches with send/recv).
     The blocks run under ``lax.scan``, TP params keep their 'mp' specs, and
     ids/labels are expected dp-sharded on the batch dim, so one jit covers
-    dp x mp x pp.  ``remat=True`` wraps each block in jax.checkpoint — the
-    reference's RecomputeOptimizer role (fluid/optimizer.py:5407).
+    dp x mp x pp.  ``remat``: True wraps each block in jax.checkpoint
+    (reference RecomputeOptimizer role, fluid/optimizer.py:5407); the
+    string ``"dots"`` selects selective remat (matmul outputs saved,
+    elementwise recomputed); False disables rematerialization.
 
     ``sharding_stage`` = ZeRO over the 'sharding' mesh axis (parity:
     ``fleet/meta_optimizers/sharding_optimizer.py:503`` and the dygraph
@@ -404,13 +406,23 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                     for p, a in zip(objs, saved):
                         p._array = a
 
+            def wrap_remat(fn):
+                if remat == "dots":
+                    # selective remat: keep matmul outputs, recompute the
+                    # cheap elementwise/norm ops — a middle ground between
+                    # full remat and no-remat
+                    return jax.checkpoint(
+                        fn, policy=jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable)
+                return jax.checkpoint(fn) if remat else fn
+
             if homogeneous:
                 tpl_objs = block_param_objs[0]
 
                 def one_block(h, leaves):
                     return _constrain_dp(block_fn(blocks[0], tpl_objs, leaves, h))
 
-                body = jax.checkpoint(one_block) if remat else one_block
+                body = wrap_remat(one_block)
 
                 def scan_body(h, leaves):
                     return body(h, leaves), None
@@ -418,9 +430,7 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                 x, _ = lax.scan(scan_body, x, tuple(stacked_leaves))
             else:
                 for blk in blocks:
-                    f = (jax.checkpoint(lambda h, b=blk: block_fn(b, [], [], h))
-                         if remat else (lambda h, b=blk: block_fn(b, [], [], h)))
-                    x = f(x)
+                    x = wrap_remat(lambda h, b=blk: block_fn(b, [], [], h))(x)
             x = model.gpt.ln_f(Tensor(x, stop_gradient=True))._array
             w = model.gpt.embeddings.word_embeddings.weight._array
             return x, w

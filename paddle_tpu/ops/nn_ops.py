@@ -374,6 +374,11 @@ def dropout_kernel(ins, attrs, rng=None):
     p = attrs.get("dropout_prob", 0.5)
     is_test = attrs.get("is_test", False)
     impl = attrs.get("dropout_implementation", "upscale_in_train")
+    if p == 0.0 and not is_test:
+        # identity — and critically, NO rng draw: with a traced per-step key
+        # a p=0 mask would be generated live every step instead of being
+        # constant-folded by XLA
+        return {"Out": x, "Mask": jnp.ones(x.shape, dtype=jnp.uint8)}
     if is_test:
         if impl == "upscale_in_train":
             return {"Out": x, "Mask": jnp.ones(x.shape, dtype=jnp.uint8)}

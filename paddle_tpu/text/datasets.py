@@ -212,19 +212,26 @@ class Movielens(Dataset):
             if line.strip():
                 yield line.strip().split("::")
 
+    _TITLE_YEAR = re.compile(r"(.*)\((\d{4})\)$")
+
     def _load(self):
         categories, titles = {}, {}
         self.movie_info, self.user_info = {}, {}
         with zipfile.ZipFile(self.data_file) as zf:
             for mid, title, cats in self._read(zf, "movies.dat"):
+                # reference (movielens.py MovieInfo): strip the trailing
+                # '(year)' and lowercase before building the title vocab
+                m = self._TITLE_YEAR.match(title)
+                words = [w.lower() for w in
+                         (m.group(1) if m else title).split()]
                 for c in cats.split("|"):
                     categories.setdefault(c, len(categories))
-                for w in title.split():
+                for w in words:
                     titles.setdefault(w, len(titles))
                 self.movie_info[int(mid)] = (
                     int(mid),
                     [categories[c] for c in cats.split("|")],
-                    [titles[w] for w in title.split()],
+                    [titles[w] for w in words],
                 )
             age_table = [1, 18, 25, 35, 45, 50, 56]  # movielens.py:36
             for uid, gender, age, job, _zip in self._read(zf, "users.dat"):
@@ -317,9 +324,9 @@ class WMT14(Dataset):
 
 
 class WMT16(Dataset):
-    """WMT16 en-de (Multi30k).  Parity: wmt16.py:106 — dicts are built
-    from ``wmt16/train`` on first use and cached next to the archive;
-    items are (src_ids, trg_ids, trg_ids_next)."""
+    """WMT16 en-de (Multi30k).  Parity: wmt16.py:106 — both language dicts
+    are built from ``wmt16/train`` in ONE archive pass; items are
+    (src_ids, trg_ids, trg_ids_next)."""
 
     def __init__(self, data_file=None, mode="train", src_dict_size=-1,
                  trg_dict_size=-1, lang="en", download=True):
@@ -331,27 +338,35 @@ class WMT16(Dataset):
         self.data_file = _require(
             data_file, "wmt16.tar.gz (Multi30k)",
             "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz")
-        self.src_dict = self._build_dict(lang, src_dict_size)
-        self.trg_dict = self._build_dict("de" if lang == "en" else "en",
-                                         trg_dict_size)
+        en_dict, de_dict = self._build_dicts(src_dict_size if lang == "en"
+                                             else trg_dict_size,
+                                             trg_dict_size if lang == "en"
+                                             else src_dict_size)
+        self.src_dict = en_dict if lang == "en" else de_dict
+        self.trg_dict = de_dict if lang == "en" else en_dict
         self._load()
 
-    def _build_dict(self, lang, size):
-        freq = collections.defaultdict(int)
-        col = 0 if lang == "en" else 1
+    def _build_dicts(self, en_size, de_size):
+        """One pass over wmt16/train building both language vocabs."""
+        freqs = (collections.defaultdict(int), collections.defaultdict(int))
         with tarfile.open(self.data_file) as tf:
             name = [m.name for m in tf if m.name.endswith("wmt16/train")][0]
             for line in tf.extractfile(name):
                 parts = line.decode("utf-8", "replace").strip().split("\t")
                 if len(parts) != 2:
                     continue
-                for w in parts[col].split():
-                    freq[w] += 1
-        words = [_WMT_START, _WMT_END, _WMT_UNK] + [
-            w for w, _ in sorted(freq.items(), key=lambda x: -x[1])]
-        if size > 0:
-            words = words[:size]
-        return {w: i for i, w in enumerate(words)}
+                for col in (0, 1):
+                    for w in parts[col].split():
+                        freqs[col][w] += 1
+
+        def mk(freq, size):
+            words = [_WMT_START, _WMT_END, _WMT_UNK] + [
+                w for w, _ in sorted(freq.items(), key=lambda x: -x[1])]
+            if size > 0:
+                words = words[:size]
+            return {w: i for i, w in enumerate(words)}
+
+        return mk(freqs[0], en_size), mk(freqs[1], de_size)
 
     def _load(self):
         start = self.src_dict[_WMT_START]

@@ -78,6 +78,24 @@ def test_zero_stages_match_unsharded():
     assert any(_has_sharding_axis(p) for p in flat_p3)
 
 
+def test_selective_remat_dots_policy():
+    """remat='dots' (matmul-saving checkpoint policy) trains identically."""
+    _init(dp=2, sharding=1)
+    paddle.seed(0)
+    model = GPTForPretraining(GPTConfig(**CFG))
+    step, params, opt_state = build_functional_train_step(
+        model, lr=1e-3, remat="dots", ce_chunk_rows=0, sharding_stage=0)
+    rng = np.random.RandomState(0)
+    ids = mesh_mod.shard_batch(rng.randint(0, 128, (8, 16)).astype("int32"))
+    labels = mesh_mod.shard_batch(rng.randint(0, 128, (8, 16)).astype("int64"))
+    losses = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+        losses.append(float(np.asarray(loss)))
+    ref, _, _ = _train(stage=0, steps=2)
+    np.testing.assert_allclose(losses, ref[:2], rtol=2e-5, atol=2e-5)
+
+
 def test_zero_stage_from_strategy():
     """sharding_configs['stage'] selects the stage when not passed."""
     _init(dp=2, sharding=2, stage=3)
