@@ -66,6 +66,8 @@ from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import hapi  # noqa: F401
 from . import text  # noqa: F401
+from . import inference  # noqa: F401
+from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 

@@ -16,3 +16,5 @@ from .collective import (  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401

@@ -17,6 +17,7 @@ from .. import mesh as mesh_mod
 from .distributed_strategy import DistributedStrategy
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from . import meta_parallel  # noqa: F401
+from . import elastic  # noqa: F401
 from ..parallel import init_parallel_env
 
 __all__ = [

@@ -1,0 +1,105 @@
+"""Elastic training / failure detection.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/elastic.py:99``
+(ElasticManager: etcd-backed member registry, heartbeat watchdog,
+scale-in/out decisions).  TPU-first minimal core: the rendezvous store is
+a FILESYSTEM directory (shared FS on pods; localhost for tests) instead of
+etcd — ranks heartbeat by touching ``{store}/rank_{i}``; the watcher flags
+ranks whose heartbeat is stale, and the launcher can restart the job when
+membership changes.  The reference's etcd client is an optional transport
+behind the same API.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """File-store elastic membership + heartbeat watchdog."""
+
+    def __init__(self, store_dir: Optional[str] = None,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 timeout: float = 30.0):
+        self.store = store_dir or os.environ.get(
+            "PADDLE_ELASTIC_STORE", "/tmp/paddle_tpu_elastic")
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = world_size if world_size is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.timeout = float(
+            os.environ.get("PADDLE_ELASTIC_TIMEOUT", timeout))
+        os.makedirs(self.store, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        """Parity: elastic is on when the env requests it (np range set)."""
+        return bool(os.environ.get("PADDLE_ELASTIC_NP")
+                    or os.environ.get("PADDLE_ELASTIC_STORE"))
+
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self.store, f"rank_{rank}")
+
+    def register(self) -> None:
+        """Join the membership (first heartbeat)."""
+        self.beat()
+
+    def beat(self) -> None:
+        """Heartbeat — cheap atomic mtime bump."""
+        p = self._hb_path(self.rank)
+        with open(p, "a"):
+            os.utime(p, None)
+
+    def exit(self) -> None:
+        """Leave cleanly (no failure flagged for this rank)."""
+        try:
+            os.remove(self._hb_path(self.rank))
+        except FileNotFoundError:
+            pass
+
+    def alive_ranks(self) -> List[int]:
+        now = time.time()
+        out = []
+        for r in range(self.world_size):
+            p = self._hb_path(r)
+            try:
+                if now - os.path.getmtime(p) <= self.timeout:
+                    out.append(r)
+            except FileNotFoundError:
+                pass
+        return out
+
+    def failed_ranks(self) -> List[int]:
+        """Ranks that registered but stopped heartbeating (stale mtime)."""
+        now = time.time()
+        out = []
+        for r in range(self.world_size):
+            p = self._hb_path(r)
+            try:
+                if now - os.path.getmtime(p) > self.timeout:
+                    out.append(r)
+            except FileNotFoundError:
+                continue  # never registered or exited cleanly
+        return out
+
+    def watch(self) -> str:
+        """One watchdog poll (parity: ElasticManager.watch loop body)."""
+        failed = self.failed_ranks()
+        if failed:
+            return ElasticStatus.RESTART
+        if not os.listdir(self.store):
+            return ElasticStatus.COMPLETED
+        return ElasticStatus.HOLD

@@ -1,0 +1,224 @@
+"""``paddle.incubate`` — wrapper optimizers.
+
+Parity: ``/root/reference/python/paddle/fluid/optimizer.py``:
+ExponentialMovingAverage (:3883), ModelAverage (:3574), LookaheadOptimizer
+(:6088), GradientMergeOptimizer (:6260) — re-built for the dygraph tape
+(the reference versions rewrite static programs; here they are array-state
+wrappers over the eager optimizer, the paddle 2.x incubate flavor).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "ExponentialMovingAverage", "LookAhead", "ModelAverage",
+    "GradientMergeOptimizer",
+]
+
+
+def _unique(params):
+    seen, out = set(), []
+    for p in params:
+        if id(p) not in seen:
+            seen.add(id(p))
+            out.append(p)
+    return out
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param after each update.
+
+    Parity: fluid/optimizer.py:3883 — ``update()`` after every optimizer
+    step; ``apply()`` context swaps the EMA weights in for evaluation and
+    restores on exit (or call ``restore()`` manually)."""
+
+    def __init__(self, parameters, decay: float = 0.999,
+                 thres_steps: Optional[int] = None, name=None):
+        self._params = _unique(parameters)
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._step = 0
+        self._shadow = {id(p): p._array.astype(jnp.float32)
+                        for p in self._params}
+        self._backup = None
+
+    def update(self):
+        self._step += 1
+        decay = self._decay
+        if self._thres_steps is not None:
+            # dynamic decay warmup: min(decay, (1+t)/(10+t))
+            decay = min(decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            sh = self._shadow[id(p)]
+            self._shadow[id(p)] = (decay * sh
+                                   + (1.0 - decay) * p._array.astype(jnp.float32))
+
+    @contextlib.contextmanager
+    def apply(self, need_restore: bool = True):
+        self._backup = {id(p): p._array for p in self._params}
+        for p in self._params:
+            p._array = self._shadow[id(p)].astype(p._array.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._array = self._backup[id(p)]
+        self._backup = None
+
+
+class LookAhead:
+    """k fast steps, then slow += alpha * (fast - slow); fast = slow.
+
+    Parity: fluid/optimizer.py:6088 LookaheadOptimizer (paddle 2.x
+    ``paddle.incubate.LookAhead`` wrapper form)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._params = _unique(inner_optimizer._parameter_list or [])
+        self._slow = {id(p): p._array for p in self._params}
+        self._step = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            for p in self._params:
+                slow = self._slow[id(p)].astype(jnp.float32)
+                fast = p._array.astype(jnp.float32)
+                new_slow = slow + self.alpha * (fast - slow)
+                self._slow[id(p)] = new_slow.astype(p._array.dtype)
+                p._array = self._slow[id(p)]
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window.
+
+    Parity: fluid/optimizer.py:3574 ModelAverage /
+    ``paddle.incubate.ModelAverage`` — ``step()`` accumulates; ``apply()``
+    swaps the averaged weights in for evaluation; ``restore()`` undoes."""
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        self._params = _unique(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._array, dtype=jnp.float32)
+                     for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._count += 1
+        window = max(self._min_w,
+                     min(self._max_w, int(self._count * self._rate) or 1))
+        for p in self._params:
+            s = self._sum[id(p)] + p._array.astype(jnp.float32)
+            # keep the sum bounded to the window by exponential forgetting
+            if self._count > window:
+                s = s * (window / (window + 1.0))
+            self._sum[id(p)] = s
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        self._backup = {id(p): p._array for p in self._params}
+        n = max(min(self._count,
+                    max(self._min_w, int(self._count * self._rate) or 1)), 1)
+        for p in self._params:
+            p._array = (self._sum[id(p)] / n).astype(p._array.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._array = self._backup[id(p)]
+        self._backup = None
+
+
+class GradientMergeOptimizer:
+    """Accumulate gradients for k_steps micro-batches, then apply one real
+    optimizer step with the averaged gradient.
+
+    Parity: fluid/optimizer.py:6260 GradientMergeOptimizer — the
+    large-effective-batch path when memory caps the per-step batch."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = max(int(k_steps), 1)
+        self.avg = avg
+        self._params = _unique(inner_optimizer._parameter_list or [])
+        self._acc = {}
+        self._step = 0
+
+    def step(self):
+        self._step += 1
+        for p in self._params:
+            if p.grad is None:
+                continue
+            g = p.grad._array.astype(jnp.float32)
+            self._acc[id(p)] = self._acc.get(id(p), 0.0) + g
+        if self._step % self.k_steps == 0:
+            scale = 1.0 / self.k_steps if self.avg else 1.0
+            for p in self._params:
+                if id(p) in self._acc:
+                    p.grad._array = (self._acc[id(p)] * scale).astype(
+                        p.grad._array.dtype)
+            self.inner_optimizer.step()
+            self._acc = {}
+            self.inner_optimizer.clear_grad()
+        else:
+            # grads consumed into the accumulator; clear for the next micro
+            self.inner_optimizer.clear_grad()
+
+    def clear_grad(self):
+        pass  # handled inside step()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)

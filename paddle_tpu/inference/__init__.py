@@ -1,0 +1,202 @@
+"""``paddle.inference`` — the deployment Predictor/Config API.
+
+Parity: ``/root/reference/paddle/fluid/inference/api/analysis_predictor.h:82``
+(AnalysisPredictor) and ``paddle_analysis_config.h`` (AnalysisConfig) — the
+C++ engine the reference builds for serving (47k LoC: IR passes, memory
+optimization, TensorRT/MKLDNN backends).
+
+TPU-first: the saved inference Program lowers to ONE cached XLA executable
+(the static Executor), so the reference's IR-pass pipeline, memory reuse
+passes, and kernel selection are all delegated to the XLA compiler; the
+Predictor is a thin stateful handle with the reference's zero-copy tensor
+API surface.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    XPU = 3
+
+
+class Config:
+    """Parity: AnalysisConfig — model path + toggles.  Most reference
+    knobs configure subsystems XLA owns here; they are accepted and
+    recorded so deployment scripts run unmodified."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle 2.x convention: Config("path/prefix") with combined files
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._prefix = None
+        if prog_file is not None and params_file is None:
+            self._prefix = prog_file
+        elif prog_file is not None and prog_file.endswith(".pdmodel.json"):
+            self._prefix = prog_file[: -len(".pdmodel.json")]
+        self._device = "tpu"
+        self._device_id = 0
+        self._amp = None
+        self._opts: Dict[str, object] = {}
+
+    # -- model location -------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        self.__init__(prog_file, params_file)
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    def model_dir(self):
+        return self._prefix
+
+    # -- device ----------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "gpu", device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    # -- precision / graph options (owned by XLA; recorded) ---------------
+    def enable_memory_optim(self, *a, **k):
+        self._opts["memory_optim"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._opts["ir_optim"] = flag
+
+    def enable_mkldnn(self):
+        self._opts["mkldnn"] = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._opts["cpu_threads"] = n
+
+    def enable_tensorrt_engine(self, *a, precision_mode=PrecisionType.Float32,
+                               **k):
+        # TRT role ≙ XLA fusion; bf16 precision maps to an AMP rewrite
+        self._amp = ("bfloat16" if precision_mode in
+                     (PrecisionType.Half, PrecisionType.Bfloat16) else None)
+
+    def enable_bf16(self):
+        self._amp = "bfloat16"
+
+    def summary(self):
+        return {"model": self._prefix, "device": self._device,
+                "amp": self._amp, **self._opts}
+
+
+class Tensor:
+    """Parity: ZeroCopyTensor — named input/output handle."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray) -> None:
+        assert self._is_input, f"{self.name} is an output handle"
+        self._pred._feeds[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert not self._is_input, f"{self.name} is an input handle"
+        return np.asarray(self._pred._results[self.name])
+
+    def shape(self) -> List[int]:
+        if self._is_input:
+            a = self._pred._feeds.get(self.name)
+        else:
+            a = self._pred._results.get(self.name)
+        return list(a.shape) if a is not None else []
+
+    def reshape(self, shape) -> None:  # reference API; shapes are dynamic
+        pass
+
+
+class Predictor:
+    """Parity: AnalysisPredictor:82 — run() over named zero-copy handles.
+
+    The loaded inference Program compiles once per feed-shape set through
+    the whole-block XLA Executor (program cache keyed on shapes)."""
+
+    def __init__(self, config: Config):
+        from ..framework.scope import Scope
+        from ..static.executor import Executor
+        from ..static.io import load_inference_model
+
+        self._config = config
+        self._scope = Scope()
+        self._exe = Executor()
+        prefix = config.model_dir() or config.prog_file()
+        if prefix is None:
+            raise ValueError("Config has no model path; call set_model()")
+        self._program, self._feed_names, self._fetch_names = \
+            load_inference_model(prefix, self._exe, scope=self._scope)
+        if config._amp == "bfloat16":
+            from ..static.amp import rewrite_program
+
+            rewrite_program(self._program)
+        self._feeds: Dict[str, np.ndarray] = {}
+        self._results: Dict[str, np.ndarray] = {}
+
+    # -- handles ----------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        assert name in self._feed_names, name
+        return Tensor(name, self, is_input=True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        assert name in self._fetch_names, name
+        return Tensor(name, self, is_input=False)
+
+    # -- execution --------------------------------------------------------
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Reference dual API: ``run()`` after copy_from_cpu, or
+        ``run([arr, ...])`` returning the outputs directly."""
+        if inputs is not None:
+            for name, arr in zip(self._feed_names, inputs):
+                self._feeds[name] = np.asarray(arr)
+        missing = [n for n in self._feed_names if n not in self._feeds]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        outs = self._exe.run(self._program, feed=dict(self._feeds),
+                             fetch_list=list(self._fetch_names),
+                             scope=self._scope)
+        self._results = dict(zip(self._fetch_names, outs))
+        return [self._results[n] for n in self._fetch_names]
+
+    def clone(self) -> "Predictor":
+        return Predictor(self._config)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Parity: paddle_infer.create_predictor."""
+    return Predictor(config)

@@ -414,6 +414,9 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
                     return jax.checkpoint(
                         fn, policy=jax.checkpoint_policies
                         .dots_with_no_batch_dims_saveable)
+                if not isinstance(remat, bool):
+                    raise ValueError(
+                        f"remat must be True, False, or 'dots'; got {remat!r}")
                 return jax.checkpoint(fn) if remat else fn
 
             if homogeneous:

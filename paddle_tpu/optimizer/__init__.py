@@ -27,8 +27,8 @@ from . import lr as lr_sched_mod
 from .lr import LRScheduler
 
 __all__ = [
-    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "RMSProp",
-    "Adagrad", "lr",
+    "Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW", "Lamb",
+    "RMSProp", "Adagrad", "lr",
 ]
 
 lr = lr_sched_mod
@@ -333,6 +333,33 @@ class Momentum(Optimizer):
              "LearningRate": [self._lr_input()]},
             {"ParamOut": p, "VelocityOut": vel},
             {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentum(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (parity:
+    ``fluid/optimizer.py`` LarsMomentumOptimizer / lars_momentum_op) —
+    large-batch vision training."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, epsilon=0.0,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, p, g):
+        vel = self._add_accumulator("velocity", p)
+        self._run_update(
+            "lars_momentum",
+            {"Param": [p], "Grad": [g], "Velocity": [vel],
+             "LearningRate": [self._lr_input()]},
+            {"ParamOut": p, "VelocityOut": vel},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay,
+             "epsilon": self._epsilon},
         )
 
 
