@@ -18,6 +18,7 @@ from .distributed_strategy import DistributedStrategy
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from . import meta_parallel  # noqa: F401
 from . import elastic  # noqa: F401
+from . import meta_optimizers  # noqa: F401
 from ..parallel import init_parallel_env
 
 __all__ = [

@@ -15,9 +15,11 @@ from typing import List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from . import asp  # noqa: F401
+
 __all__ = [
     "ExponentialMovingAverage", "LookAhead", "ModelAverage",
-    "GradientMergeOptimizer",
+    "GradientMergeOptimizer", "asp",
 ]
 
 

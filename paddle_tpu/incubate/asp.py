@@ -1,0 +1,142 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Parity: ``/root/reference/python/paddle/fluid/contrib/sparsity/`` (asp.py:
+``prune_model``, ``decorate``; utils.py: ``get_mask_1d``,
+``check_sparsity``, ``calculate_density``).  TPU note: v5e MXUs do not
+accelerate 2:4 sparsity the way sparse tensor cores do, so here ASP is a
+MODEL-QUALITY tool (train-time structured pruning with masks maintained
+across optimizer steps); the mask math and API match the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "calculate_density", "check_sparsity", "get_mask_1d", "prune_model",
+    "decorate", "reset_excluded_layers", "set_excluded_layers", "ASPHelper",
+]
+
+_EXCLUDED: set = set()
+
+
+def calculate_density(x) -> float:
+    """Parity: sparsity/utils.py calculate_density."""
+    a = np.asarray(x)
+    return float(np.count_nonzero(a)) / a.size
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the last dim: keep the n largest |values| of every
+    group of m (parity: utils.py get_mask_1d)."""
+    a = np.asarray(mat)
+    shape = a.shape
+    assert shape[-1] % m == 0, f"last dim {shape[-1]} not divisible by {m}"
+    g = np.abs(a).reshape(-1, m)
+    order = np.argsort(g, axis=1)  # ascending
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[:, m - n:], True, axis=1)
+    return mask.reshape(shape)
+
+
+def check_sparsity(mat, n: int = 2, m: int = 4) -> bool:
+    """True when every m-group along the last dim has <= n non-zeros."""
+    a = np.asarray(mat)
+    if a.shape[-1] % m:
+        return False
+    g = (np.abs(a.reshape(-1, m)) > 0).sum(axis=1)
+    return bool((g <= n).all())
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(p, m: int = 4) -> bool:
+    return (p._array.ndim == 2 and p.shape[-1] % m == 0
+            and not getattr(p, "stop_gradient", False)
+            and p.name not in _EXCLUDED)
+
+
+class ASPHelper:
+    """Holds the masks for a set of parameters (asp.py ASPHelper role)."""
+
+    def __init__(self):
+        import weakref
+
+        # weak refs: a pruned-then-discarded model must not stay alive (or
+        # keep being re-masked) through this registry
+        self._masks: Dict[int, jnp.ndarray] = {}
+        self._params: "weakref.WeakValueDictionary[int, object]" = (
+            weakref.WeakValueDictionary())
+
+    def prune(self, params, n=2, m=4):
+        for p in params:
+            if not _prunable(p, m):
+                continue
+            mask = jnp.asarray(get_mask_1d(np.asarray(p._array), n, m),
+                               dtype=p._array.dtype)
+            p._array = p._array * mask
+            self._masks[id(p)] = mask  # re-prune replaces, never duplicates
+            self._params[id(p)] = p
+        return self
+
+    def apply_masks(self):
+        dead = [k for k in self._masks if k not in self._params]
+        for k in dead:
+            del self._masks[k]
+        for k, p in list(self._params.items()):
+            p._array = p._array * self._masks[k]
+
+    def reset(self):
+        self._masks.clear()
+        self._params = type(self._params)()
+
+
+_helper = ASPHelper()
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> ASPHelper:
+    """Parity: asp.py prune_model — mask every prunable 2-D weight of the
+    Layer (or parameter list) to n:m sparsity."""
+    params = model.parameters() if hasattr(model, "parameters") else model
+    return _helper.prune(list(params), n, m)
+
+
+class DecoratedASPOptimizer:
+    """Re-applies the sparsity masks after every optimizer step (parity:
+    asp.py ASPHelper._insert_sparse_mask_ops / OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer, helper: Optional[ASPHelper] = None):
+        self._inner = optimizer
+        self._helper = helper or _helper
+
+    def step(self):
+        self._inner.step()
+        self._helper.apply_masks()
+
+    def minimize(self, loss, **kw):
+        out = self._inner.minimize(loss, **kw)
+        self._helper.apply_masks()
+        return out
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer) -> DecoratedASPOptimizer:
+    return DecoratedASPOptimizer(optimizer)

@@ -1,0 +1,7 @@
+"""``paddle.utils`` — extension loading and misc utilities.
+
+Parity: ``/root/reference/python/paddle/utils/`` (cpp_extension, op
+library loading)."""
+
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import load_op_library  # noqa: F401

@@ -1,0 +1,71 @@
+// Example custom op for tests: relu2(x) = max(x, 0) with analytic backward,
+// plus a gradless scale3 op. Built against paddle_tpu_ext.h by
+// paddle_tpu.utils.cpp_extension.load.
+#include <algorithm>
+#include <cstring>
+
+#include "paddle_tpu_ext.h"
+
+extern "C" {
+
+PT_EXPORT_OPS("relu2,scale3")
+
+// ---- relu2 ----------------------------------------------------------------
+int pt_relu2_num_outputs(void) { return 1; }
+
+int pt_relu2_infer_shape(const int64_t* in_dims, const int32_t* in_ndims,
+                         const int32_t* in_dtypes, int n_in,
+                         int64_t* out_dims, int32_t* out_ndims,
+                         int32_t* out_dtypes) {
+  if (n_in != 1) return 1;
+  out_ndims[0] = in_ndims[0];
+  out_dtypes[0] = in_dtypes[0];
+  for (int32_t j = 0; j < in_ndims[0]; ++j) out_dims[j] = in_dims[j];
+  return 0;
+}
+
+int pt_relu2_forward(const PT_Tensor* ins, int n_in, PT_Tensor* outs,
+                     int n_out) {
+  if (n_in != 1 || n_out != 1 || ins[0].dtype != PT_FLOAT32) return 1;
+  const float* x = static_cast<const float*>(ins[0].data);
+  float* y = static_cast<float*>(outs[0].data);
+  const int64_t n = pt_numel(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) y[i] = std::max(x[i], 0.0f);
+  return 0;
+}
+
+// ins = [x, grad_out]; outs = [grad_x]
+int pt_relu2_backward(const PT_Tensor* ins, int n_in, PT_Tensor* outs,
+                      int n_out) {
+  if (n_in != 2 || n_out != 1) return 1;
+  const float* x = static_cast<const float*>(ins[0].data);
+  const float* go = static_cast<const float*>(ins[1].data);
+  float* gx = static_cast<float*>(outs[0].data);
+  const int64_t n = pt_numel(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) gx[i] = x[i] > 0.0f ? go[i] : 0.0f;
+  return 0;
+}
+
+// ---- scale3 (no backward: registered no_grad) -----------------------------
+int pt_scale3_num_outputs(void) { return 1; }
+
+int pt_scale3_infer_shape(const int64_t* in_dims, const int32_t* in_ndims,
+                          const int32_t* in_dtypes, int n_in,
+                          int64_t* out_dims, int32_t* out_ndims,
+                          int32_t* out_dtypes) {
+  out_ndims[0] = in_ndims[0];
+  out_dtypes[0] = in_dtypes[0];
+  for (int32_t j = 0; j < in_ndims[0]; ++j) out_dims[j] = in_dims[j];
+  return 0;
+}
+
+int pt_scale3_forward(const PT_Tensor* ins, int n_in, PT_Tensor* outs,
+                      int n_out) {
+  const float* x = static_cast<const float*>(ins[0].data);
+  float* y = static_cast<float*>(outs[0].data);
+  const int64_t n = pt_numel(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) y[i] = 3.0f * x[i];
+  return 0;
+}
+
+}  // extern "C"
