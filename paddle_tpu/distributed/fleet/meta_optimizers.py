@@ -112,6 +112,13 @@ class DGCMomentumOptimizer:
             return
         s = self._current_sparsity()
         lr = float(self._inner.get_lr())
+        clip = getattr(self._inner, "_grad_clip", None)
+        if clip is not None:
+            # the inner optimizer is bypassed post-rampup, so apply its
+            # clip here — otherwise grad clipping silently stops at rampup
+            pgs = [(p, p.grad) for p in self._params if p.grad is not None]
+            for (p, _), (_, g2) in zip(pgs, clip(pgs)):
+                p.grad._array = g2._array
         for p in self._params:
             if p.grad is None:
                 continue

@@ -5,5 +5,7 @@ Parity: ``/root/reference/python/paddle/distributed/fleet/utils/__init__.py``.
 
 from . import recompute as recompute_mod  # noqa: F401
 from .recompute import recompute  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
 
-__all__ = ["recompute"]
+__all__ = ["recompute", "fs", "LocalFS", "HDFSClient"]

@@ -1,0 +1,175 @@
+"""Filesystem abstraction for checkpoints / data shards.
+
+Parity: ``/root/reference/python/paddle/distributed/fleet/utils/fs.py``
+(FS base:57, LocalFS:119, HDFSClient:423).  TPU pods mount shared
+filesystems (GCS-fuse/NFS), so ``LocalFS`` covers the pod case; the
+``HDFSClient`` surface is kept but requires a ``hadoop`` binary — absent
+in this zero-egress build it raises with guidance rather than shelling
+out blind.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+__all__ = [
+    "ExecuteError", "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
+    "FSShellCmdAborted", "FS", "LocalFS", "HDFSClient",
+]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    """Abstract surface (fs.py:57)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Parity: fs.py:119 — local/shared-mount filesystem."""
+
+    def ls_dir(self, fs_path):
+        """Returns ([dirs], [files]) like the reference."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        # reference semantics: these checks are UNCONDITIONAL — callers use
+        # FSFileExistsError to detect concurrent writers; silently
+        # clobbering dst would lose data
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        os.rename(src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            os.utime(fs_path, None)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def cat(self, fs_path=None):
+        with open(fs_path, "r") as f:
+            return f.read()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def need_upload_download(self):
+        return False
+
+
+class HDFSClient(FS):
+    """Parity surface: fs.py:423 — requires a hadoop CLI, absent here."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home or "", "bin", "hadoop")
+        if not os.path.exists(self._hadoop):
+            raise RuntimeError(
+                "HDFSClient needs a hadoop CLI (hadoop_home/bin/hadoop); "
+                "none found in this build — use LocalFS over a shared "
+                "mount (GCS-fuse/NFS), which is the TPU-pod deployment "
+                "path")

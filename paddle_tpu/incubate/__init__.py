@@ -16,10 +16,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import asp  # noqa: F401
+from . import quant  # noqa: F401
 
 __all__ = [
     "ExponentialMovingAverage", "LookAhead", "ModelAverage",
-    "GradientMergeOptimizer", "asp",
+    "GradientMergeOptimizer", "asp", "quant",
 ]
 
 

@@ -107,9 +107,22 @@ _helper = ASPHelper()
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
                 with_mask: bool = True) -> ASPHelper:
     """Parity: asp.py prune_model — mask every prunable 2-D weight of the
-    Layer (or parameter list) to n:m sparsity."""
-    params = model.parameters() if hasattr(model, "parameters") else model
-    return _helper.prune(list(params), n, m)
+    Layer (or parameter list) to n:m sparsity.  Only the 1-D mask family
+    is implemented; unknown algorithms raise instead of silently running
+    mask_1d.  ``with_mask=False`` prunes once without registering masks
+    (so ``decorate`` will not keep re-applying them)."""
+    if mask_algo not in ("mask_1d",):
+        raise NotImplementedError(
+            f"mask_algo {mask_algo!r} not implemented (supported: mask_1d); "
+            f"the reference's mask_2d_greedy/best search is CUDA-sparse-"
+            f"tensor-core oriented")
+    params = list(model.parameters() if hasattr(model, "parameters")
+                  else model)
+    if not with_mask:
+        tmp = ASPHelper()
+        tmp.prune(params, n, m)
+        return tmp
+    return _helper.prune(params, n, m)
 
 
 class DecoratedASPOptimizer:

@@ -1,0 +1,140 @@
+"""Imperative quantization-aware training (QAT).
+
+Parity: ``/root/reference/python/paddle/fluid/contrib/slim/quantization/
+imperative/qat.py`` (``ImperativeQuantAware``: wrap Linear/Conv2D with
+fake-quant on weights + activations; straight-through backward).
+
+TPU note: v5e serving gains come from bf16/int8 matmuls — QAT here trains
+the model THROUGH int8 rounding (fake quant in fp) so an int8 deployment
+(via the Predictor's precision knobs or an external converter) keeps
+accuracy; the fake-quant kernels live in ``ops/quant_ops.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .. import nn
+
+__all__ = ["ImperativeQuantAware", "QuantizedLinear", "QuantizedConv2D"]
+
+
+def _fake_quant(x, kind: str, bits: int, layer, state_name: str,
+                moving_rate: float = 0.9):
+    from ..dygraph import tracer
+    from ..dygraph.tensor import Tensor
+
+    if kind == "channel":
+        outs = tracer.trace_op(
+            "fake_channel_wise_quantize_dequantize_abs_max", {"X": [x]},
+            {"bit_length": bits, "quant_axis": x.ndim - 1})
+        return outs["Out"][0]
+    if kind == "abs_max":
+        outs = tracer.trace_op(
+            "fake_quantize_dequantize_abs_max", {"X": [x]},
+            {"bit_length": bits})
+        return outs["Out"][0]
+    # moving-average activation quant: the scale is a persistable BUFFER so
+    # the trained value round-trips through state_dict (a plain attribute
+    # would silently drop it on save/load)
+    scale = getattr(layer, state_name, None)
+    if scale is None:
+        scale = Tensor(np.asarray([float(np.abs(np.asarray(x._array)).max()
+                                         or 1.0)], "float32"),
+                       stop_gradient=True)
+        layer.register_buffer(state_name, scale)
+    outs = tracer.trace_op(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        {"X": [x], "InScale": [scale]},
+        {"bit_length": bits, "moving_rate": moving_rate,
+         "is_test": not layer.training})
+    if layer.training:
+        scale._array = outs["OutScale"][0]._array
+    return outs["Out"][0]
+
+
+class QuantizedLinear(nn.Layer):
+    """Linear with channel-wise weight fake-quant + moving-avg activation
+    fake-quant (qat.py QuantizedLinear role)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = inner
+        self._wbits, self._abits = weight_bits, activation_bits
+        self._rate = moving_rate
+
+    def forward(self, x):
+        from ..nn import functional as F
+        from .. import tensor_api as T
+
+        xq = _fake_quant(x, "moving", self._abits, self, "_in_scale",
+                         self._rate)
+        wq = _fake_quant(self.inner.weight, "channel", self._wbits, self,
+                         "_w_scale")
+        out = T.matmul(xq, wq)
+        if self.inner.bias is not None:
+            out = T.add(out, self.inner.bias)
+        return out
+
+
+class QuantizedConv2D(nn.Layer):
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = inner
+        self._wbits, self._abits = weight_bits, activation_bits
+        self._rate = moving_rate
+
+    def forward(self, x):
+        from ..dygraph import tracer
+
+        xq = _fake_quant(x, "moving", self._abits, self, "_in_scale",
+                         self._rate)
+        wq = _fake_quant(self.inner.weight, "abs_max", self._wbits, self,
+                         "_w_scale")
+        pad = self.inner._padding
+        pad = [pad, pad] if isinstance(pad, int) else list(pad)
+        attrs = {"strides": list(self.inner._stride),
+                 "paddings": pad,
+                 "dilations": list(self.inner._dilation),
+                 "groups": self.inner._groups}
+        outs = tracer.trace_op("conv2d", {"Input": [xq], "Filter": [wq]},
+                               attrs)
+        out = outs["Output"][0]
+        if self.inner.bias is not None:
+            from .. import tensor_api as T
+
+            b = self.inner.bias
+            out = T.add(out, T.reshape(b, [1, -1, 1, 1]))
+        return out
+
+
+_WRAPPERS = {"Linear": QuantizedLinear, "Conv2D": QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """Parity: qat.py ImperativeQuantAware — in-place layer replacement."""
+
+    def __init__(self, quantizable_layer_type: List[str] = ("Linear",
+                                                            "Conv2D"),
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 moving_rate: float = 0.9, **kw):
+        self._types = tuple(quantizable_layer_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+
+    def quantize(self, model: nn.Layer) -> nn.Layer:
+        """Replace every quantizable sublayer with its fake-quant wrapper
+        (in place, like the reference)."""
+        for name, sub in list(model._sub_layers.items()):
+            cls = type(sub).__name__
+            if cls in self._types and cls in _WRAPPERS:
+                model._sub_layers[name] = _WRAPPERS[cls](
+                    sub, self._wbits, self._abits, self._rate)
+            else:
+                self.quantize(sub)
+        return model

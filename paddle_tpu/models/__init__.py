@@ -14,3 +14,4 @@ from .gpt import (  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
 )
+from .generation import build_generate_fn, generate  # noqa: F401

@@ -1,0 +1,178 @@
+"""Autoregressive GPT decoding with a static-shape KV cache.
+
+Role parity: PaddleNLP ``GPTForGeneration`` / the reference inference
+engine's decoder path (``paddle/fluid/inference`` + fused decode kernels).
+
+TPU-first design:
+  * the WHOLE generation — prefill + ``max_new_tokens`` decode steps — is
+    ONE jitted program: the decode loop is a ``lax.scan`` over a
+    pre-allocated ``(L, B, H, S_max, D)`` KV cache updated with
+    ``lax.dynamic_update_slice`` (static shapes, no retracing per token);
+  * per decode step the query is a single token, so attention is a
+    (B, H, 1, S) matvec against the cache — bandwidth-bound, which is why
+    the cache lives in bf16 when the params do;
+  * sampling (greedy / temperature / top-k) runs on-device inside the
+    scan with a threaded PRNG key.
+
+Supports the non-tensor-parallel ``GPTForPretraining``; mp-sharded decode
+composes with GSPMD but is not wired here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_params(blk):
+    a, m = blk.attn, blk.mlp
+    return {
+        "ln1_g": blk.ln1.weight._array, "ln1_b": blk.ln1.bias._array,
+        "qkv_w": a.qkv.weight._array, "qkv_b": a.qkv.bias._array,
+        "proj_w": a.proj.weight._array, "proj_b": a.proj.bias._array,
+        "ln2_g": blk.ln2.weight._array, "ln2_b": blk.ln2.bias._array,
+        "fc1_w": m.fc1.weight._array, "fc1_b": m.fc1.bias._array,
+        "fc2_w": m.fc2.weight._array, "fc2_b": m.fc2.bias._array,
+    }
+
+
+def _ln(x, g, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
+def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps):
+    """One decoder block over ``x`` (B, T, h) with cache write at ``pos``.
+
+    Works for prefill (T = prompt len, pos = 0) and decode (T = 1,
+    pos = current length).  Returns (y, k_cache, v_cache)."""
+    b, t, h = x.shape
+    hd = h // n_heads
+    hx = _ln(x, p["ln1_g"], p["ln1_b"], eps)
+    qkv = hx @ p["qkv_w"] + p["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(z):  # (B, T, h) -> (B, H, T, hd)
+        return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+    s_max = k_cache.shape[2]
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(hd).astype(np.float32)
+    # causal + cache-validity mask over global positions
+    q_pos = pos + jnp.arange(t)[:, None]
+    kv_pos = jnp.arange(s_max)[None, :]
+    mask = kv_pos <= q_pos
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    att = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhts,bhsd->bhtd", att, v_cache)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, h)
+    x = x + out @ p["proj_w"] + p["proj_b"]
+    hx = _ln(x, p["ln2_g"], p["ln2_b"], eps)
+    x = x + jax.nn.gelu(hx @ p["fc1_w"] + p["fc1_b"],
+                        approximate=False) @ p["fc2_w"] + p["fc2_b"]
+    return x, k_cache, v_cache
+
+
+def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
+                      top_k: int = 0, greedy: bool = True):
+    """Compile ``(ids, seed) -> generated ids`` for a GPTForPretraining.
+
+    Returns ``gen(ids)`` taking a (B, prompt_len) int array and returning
+    (B, prompt_len + max_new_tokens) with the continuation appended.
+    """
+    cfg = model.cfg
+    if cfg.use_parallel:
+        raise NotImplementedError(
+            "KV-cache decode is wired for the non-TP model; shard the "
+            "generate fn with GSPMD for mp decode")
+    gpt = model.gpt
+    eps = cfg.layer_norm_eps
+    n_heads = cfg.num_heads
+    L = cfg.num_layers
+    params = {
+        "wte": gpt.embeddings.word_embeddings.weight._array,
+        "wpe": gpt.embeddings.position_embeddings.weight._array,
+        "lnf_g": gpt.ln_f.weight._array, "lnf_b": gpt.ln_f.bias._array,
+        "blocks": [_block_params(b) for b in gpt.blocks],
+    }
+
+    def logits_from(x, p):
+        x = _ln(x, p["lnf_g"], p["lnf_b"], eps)
+        return (x @ p["wte"].T).astype(jnp.float32)
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / jnp.float32(max(temperature, 1e-6))
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @functools.partial(jax.jit, static_argnums=())
+    def gen(p, ids, seed):
+        b, t0 = ids.shape
+        s_max = t0 + max_new_tokens
+        hd = cfg.hidden_size // n_heads
+        dt = p["wte"].dtype
+        kc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
+        vc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
+
+        def run(tokens, pos, kc, vc):
+            t = tokens.shape[1]
+            x = p["wte"][tokens] + p["wpe"][pos + jnp.arange(t)]
+            new_k, new_v = [], []
+            for li, bp in enumerate(p["blocks"]):
+                x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
+                                       n_heads, eps)
+                new_k.append(k1)
+                new_v.append(v1)
+            return logits_from(x, p), jnp.stack(new_k), jnp.stack(new_v)
+
+        logits, kc, vc = run(ids, 0, kc, vc)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok = sample(logits[:, -1], sub)
+
+        def step(carry, i):
+            # carry token sits at sequence position t0 + i: process it
+            # THERE (its K/V fills cache slot t0+i) and sample t0+i+1
+            tok, kc, vc, key = carry
+            logits, kc, vc = run(tok[:, None], t0 + i, kc, vc)
+            key, sub = jax.random.split(key)
+            nxt = sample(logits[:, -1], sub)
+            return (nxt, kc, vc, key), tok
+
+        (last, _, _, _), toks = lax.scan(
+            step, (tok, kc, vc, key), jnp.arange(max_new_tokens - 1))
+        out = jnp.concatenate(
+            [toks.T, last[:, None]], axis=1) if max_new_tokens > 1 \
+            else last[:, None]
+        return jnp.concatenate([ids, out.astype(ids.dtype)], axis=1)
+
+    def call(ids, seed: int = 0):
+        return gen(params, jnp.asarray(ids), seed)
+
+    return call
+
+
+def generate(model, ids, max_new_tokens: int = 32, temperature: float = 1.0,
+             top_k: int = 0, greedy: bool = True, seed: int = 0):
+    """Convenience one-shot API (compiles per (shape, knobs))."""
+    from ..dygraph.tensor import Tensor
+
+    arr = ids._array if isinstance(ids, Tensor) else np.asarray(ids)
+    fn = build_generate_fn(model, max_new_tokens, temperature, top_k, greedy)
+    out = fn(arr, seed)
+    return Tensor(out, stop_gradient=True) if isinstance(ids, Tensor) else out

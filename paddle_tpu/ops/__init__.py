@@ -13,5 +13,6 @@ from . import tensor_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
 from .dispatch import dispatch, dispatch_dygraph, dispatch_static, single  # noqa: F401
 from .registry import OpNotRegistered, get_op_def, is_registered, register_op  # noqa: F401

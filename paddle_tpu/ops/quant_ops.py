@@ -1,0 +1,72 @@
+"""Fake-quantization kernels (QAT).
+
+Parity: ``/root/reference/paddle/fluid/operators/fake_quantize_op.{cc,cu}``
+(fake_quantize_dequantize_abs_max, fake_channel_wise_*).  Straight-through
+estimator backward: the rounding is treated as identity, so the grad op is
+a plain ``assign`` (the reference registers FakeQuantDequantGradMaker with
+the same semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import GRAD_SUFFIX, register_op
+
+
+def _ste_grad_maker(op, no_grad_set):
+    """Straight-through: dX = dOut."""
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return []
+    return [{
+        "type": "assign",
+        "inputs": {"X": [op.output("Out")[0] + GRAD_SUFFIX]},
+        "outputs": {"Out": [x + GRAD_SUFFIX]},
+        "attrs": {},
+    }]
+
+
+def _fake_qdq(x, scale, bit_length):
+    bnd = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * bnd), -bnd, bnd)
+    return q * s / bnd
+
+
+@register_op("fake_quantize_dequantize_abs_max",
+             nondiff_out_slots=("OutScale",), grad_maker=_ste_grad_maker)
+def fake_qdq_abs_max_kernel(ins, attrs):
+    x = ins["X"]
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _fake_qdq(x, scale, bits),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             nondiff_out_slots=("OutScale",), grad_maker=_ste_grad_maker)
+def fake_qdq_channel_kernel(ins, attrs):
+    x = ins["X"]
+    bits = attrs.get("bit_length", 8)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = _fake_qdq(x, scale, bits)
+    return {"Out": out, "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             nondiff_slots=("InScale",), nondiff_out_slots=("OutScale",),
+             grad_maker=_ste_grad_maker)
+def fake_qdq_moving_avg_kernel(ins, attrs):
+    """Activation quant: scale is a moving average of batch abs-max."""
+    x, in_scale = ins["X"], ins["InScale"]
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    is_test = attrs.get("is_test", False)
+    new_scale = in_scale.reshape(()) if is_test else (
+        rate * in_scale.reshape(()) + (1.0 - rate) * cur)
+    return {"Out": _fake_qdq(x, new_scale, bits),
+            "OutScale": new_scale.reshape(1)}

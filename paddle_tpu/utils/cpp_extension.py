@@ -253,10 +253,16 @@ def load(name: str, sources: Sequence[str], extra_cflags: Optional[list]
         cmd += list(extra_cflags or [])
         cmd += [os.path.abspath(s) for s in sources]
         cmd += ["-o", so_path]
+        # compile to a temp name + atomic rename: an interrupted/concurrent
+        # g++ must never leave a half-written .so that later loads treat as
+        # a valid cache hit
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
+        cmd[-1] = tmp_path
         if verbose:
             print("cpp_extension:", " ".join(cmd), file=sys.stderr)
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"cpp_extension build failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp_path, so_path)
     return load_op_library(so_path)

@@ -651,6 +651,27 @@ case("check_finite_and_unscale",
                        "FoundInfinite": [("cff", None)]},
      refs={"cfo": ma / 2.0, "cff": np.asarray(False)})
 
+# ---- fake-quant (QAT) ops: output parity; STE grads are checked in
+# test_quant.py (FD through round() is meaningless: the function is flat) --
+_qx = R(51).randn(3, 4).astype("float32")
+_qs = np.abs(_qx).max()
+_qref = np.clip(np.round(_qx / _qs * 127), -127, 127) * _qs / 127
+case("fake_quantize_dequantize_abs_max", inputs={"X": _qx},
+     attrs={"bit_length": 8},
+     refs={"Out": _qref.astype("float32")}, atol=1e-6)
+_qsc = np.abs(_qx).max(axis=0, keepdims=True)
+_qcref = np.clip(np.round(_qx / _qsc * 127), -127, 127) * _qsc / 127
+case("fake_channel_wise_quantize_dequantize_abs_max", inputs={"X": _qx},
+     attrs={"bit_length": 8, "quant_axis": 1},
+     refs={"Out": _qcref.astype("float32")}, atol=1e-6)
+_qin = np.array([1.0], "float32")
+_qms = 0.9 * 1.0 + 0.1 * _qs
+_qmref = np.clip(np.round(_qx / _qms * 127), -127, 127) * _qms / 127
+case("fake_quantize_dequantize_moving_average_abs_max",
+     inputs={"X": _qx, "InScale": _qin},
+     attrs={"bit_length": 8, "moving_rate": 0.9},
+     refs={"Out": _qmref.astype("float32")}, atol=1e-6)
+
 # ---- stochastic ops: moment/shape checks (own tests) ----------------------
 STOCHASTIC = {
     "gaussian_random": ({"shape": [400], "mean": 1.0, "std": 2.0,

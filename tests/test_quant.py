@@ -1,0 +1,77 @@
+"""QAT (ImperativeQuantAware): fake-quant wrappers + straight-through
+gradients (reference slim/quantization/imperative/qat.py role)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.incubate.quant import ImperativeQuantAware, QuantizedLinear
+
+
+def test_ste_gradient_passes_through():
+    x = paddle.to_tensor(np.linspace(-2, 2, 12).astype("float32")
+                         .reshape(3, 4), stop_gradient=False)
+    from paddle_tpu.dygraph import tracer
+
+    out = tracer.trace_op("fake_quantize_dequantize_abs_max",
+                          {"X": [x]}, {"bit_length": 8})["Out"][0]
+    out.sum().backward()
+    # straight-through: grad of sum == ones, untouched by the rounding
+    np.testing.assert_array_equal(np.asarray(x.grad._array),
+                                  np.ones((3, 4), "float32"))
+
+
+def test_quantize_replaces_layers_and_trains():
+    paddle.seed(0)
+    net = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(),
+        nn.Sequential(nn.Linear(16, 4)),  # nested: recursion must find it
+        nn.ReLU(), nn.Linear(4, 1),
+    )
+    ImperativeQuantAware().quantize(net)
+    quantized = [m for m in net.sublayers() if isinstance(m, QuantizedLinear)]
+    assert len(quantized) == 3
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randn(16, 1).astype("float32"))
+    o = opt.Adam(learning_rate=0.01, parameters=net.parameters())
+    losses = []
+    for _ in range(15):
+        loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # activation scale state exists and is finite
+    assert np.isfinite(np.asarray(quantized[0]._in_scale._array)).all()
+
+    # eval mode: moving scale frozen
+    net.eval()
+    s_before = float(np.asarray(quantized[0]._in_scale._array)[0])
+    net(x)
+    assert float(np.asarray(quantized[0]._in_scale._array)[0]) == s_before
+
+    # the trained scale is a persisted buffer: it round-trips state_dict
+    sd = net.state_dict()
+    scale_keys = [k for k in sd if k.endswith("_in_scale")]
+    assert scale_keys, list(sd)[:8]
+
+
+def test_quantized_conv2d():
+    from paddle_tpu.incubate.quant import QuantizedConv2D
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU())
+    ImperativeQuantAware().quantize(net)
+    assert isinstance(net[0], QuantizedConv2D)
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(2, 3, 8, 8).astype("float32"),
+                         stop_gradient=False)
+    out = net(x)
+    assert out.shape == [2, 4, 8, 8]
+    out.mean().backward()
+    assert x.grad is not None
