@@ -68,6 +68,7 @@ from . import hapi  # noqa: F401
 from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
+from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 

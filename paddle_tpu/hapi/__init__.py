@@ -3,3 +3,4 @@
 from .model import Model, InputSpec  # noqa: F401
 from . import callbacks  # noqa: F401
 from .progressbar import ProgressBar  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401

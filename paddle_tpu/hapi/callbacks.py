@@ -167,6 +167,73 @@ class LRScheduler(Callback):
             s.step()
 
 
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi/callbacks.py:956 — self-contained plateau tracker that
+    fires on EVAL end only (never on train logs) and reduces the
+    optimizer's float learning rate in place."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError(
+                "ReduceLROnPlateau does not support a factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._reset()
+
+    def _reset(self):
+        import numpy as np
+
+        if self.mode == "max" or (self.mode == "auto"
+                                  and "acc" in self.monitor):
+            self._better = lambda a, b: a > b + self.min_delta
+            self.best = -np.inf
+        else:
+            self._better = lambda a, b: a < b - self.min_delta
+            self.best = np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_eval_end(self, logs=None):
+        if not logs or self.monitor not in logs:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None or not isinstance(
+                getattr(opt, "_learning_rate", None), float):
+            return  # reference: only float LRs are managed
+        val = logs[self.monitor]
+        if isinstance(val, (list, tuple)):
+            val = val[0]
+        current = float(val)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                old = opt.get_lr()
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
 class VisualDL(Callback):
     """Stub (VisualDL itself is not available in this build)."""
 

@@ -1,0 +1,30 @@
+"""``paddle.onnx`` — export surface.
+
+Parity: ``/root/reference/python/paddle/onnx/export.py`` (which delegates
+to the external ``paddle2onnx`` package).  The ``onnx`` python package is
+not in this build's baked environment; when it IS present, a basic
+Program->ONNX conversion could be layered over the saved inference model
+(static/io.py), so ``export`` probes for it and raises with actionable
+guidance otherwise — matching the reference's hard dependency error.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Parity: paddle.onnx.export — requires the ``onnx`` package."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export requires the 'onnx' package (the reference "
+            "delegates to paddle2onnx the same way); it is not part of this "
+            "build's baked environment. For deployment use "
+            "paddle.inference.Predictor over save_inference_model, or "
+            "jax.export for StableHLO serialization."
+        ) from e
+    raise NotImplementedError(
+        "ONNX graph conversion is not implemented; use "
+        "paddle.inference.Predictor (XLA) or jax.export (StableHLO)")

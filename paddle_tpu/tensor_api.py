@@ -43,6 +43,7 @@ __all__ = [
     "argmin", "argsort", "sort", "cast", "slice", "strided_slice",
     "take_along_axis", "broadcast_to", "meshgrid", "norm", "dist", "kron",
     "flops", "increment", "is_tensor", "shape", "real", "create_parameter",
+    "create_array", "array_write", "array_read", "array_length",
     "multiplex", "histogram", "bincount", "cross", "diag", "mv",
 ]
 
@@ -729,8 +730,54 @@ def shape(x):
     return single(dispatch("shape", {"Input": [x]}, {}))
 
 
-def flops(*a, **k):
-    return 0
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Parity: paddle.flops — implemented in hapi.dynamic_flops."""
+    from .hapi.dynamic_flops import flops as _flops
+
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
+
+
+# -- LoDTensorArray surface (dygraph semantics: a python list — the same
+# -- thing the reference's dygraph mode uses; fluid/layers/tensor.py
+# -- create_array:1480, array_write, array_read, array_length) --------------
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = list(initialized_list or [])
+    for v in arr:
+        if not hasattr(v, "_array"):
+            raise TypeError(
+                f"create_array initialized_list expects Tensors, got {type(v)}")
+    return arr
+
+
+def array_write(x, i, array=None):
+    idx = int(np.asarray(i._array if hasattr(i, "_array") else i))
+    if array is None:
+        array = []
+    if idx > len(array):
+        # reference dygraph path asserts i <= len(array): a gap would make
+        # a later array_read return nothing, crashing far from the bad write
+        raise IndexError(
+            f"array_write index {idx} out of range for array of length "
+            f"{len(array)} (must be <= length)")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = int(np.asarray(i._array if hasattr(i, "_array") else i))
+    return array[idx]
+
+
+def array_length(array):
+    from .dygraph.tensor import Tensor
+
+    return Tensor(np.asarray(len(array), dtype="int64"), stop_gradient=True)
 
 
 # ---------------------------------------------------------------------------

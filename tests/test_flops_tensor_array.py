@@ -1,0 +1,35 @@
+"""paddle.flops (hapi dynamic_flops) + LoDTensorArray surface."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import LeNet
+
+
+def test_flops_lenet():
+    n = paddle.flops(LeNet(), [1, 1, 28, 28])
+    # conv1: 28*28*6*25 + conv2: 10*10*16*6*25 + fc: dominate ~349k MACs
+    assert 3e5 < n < 4e5, n
+    # custom override wins
+    from paddle_tpu import nn
+
+    n2 = paddle.flops(LeNet(), [1, 1, 28, 28],
+                      custom_ops={nn.Linear: lambda m, x, y: 0})
+    assert n2 < n
+
+
+def test_tensor_array_roundtrip():
+    arr = paddle.create_array()
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    i = paddle.to_tensor(np.asarray(0, "int64"))
+    arr = paddle.array_write(x, i, arr)
+    arr = paddle.array_write(x * 2, paddle.to_tensor(np.asarray(1, "int64")),
+                             arr)
+    assert int(paddle.array_length(arr).numpy()) == 2
+    got = paddle.array_read(arr, i)
+    np.testing.assert_array_equal(np.asarray(got._array), np.ones((2, 2)))
+    # gaps are rejected at the write (reference assert i <= len(array))
+    import pytest as _pytest
+
+    with _pytest.raises(IndexError):
+        paddle.array_write(x, paddle.to_tensor(np.asarray(9, "int64")), arr)
