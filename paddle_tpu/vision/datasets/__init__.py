@@ -18,7 +18,11 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
+from .extra import Flowers, VOC2012  # noqa: F401
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 _NO_DOWNLOAD = (
     "this build runs without network egress: place the dataset files locally "

@@ -1,0 +1,158 @@
+"""Flowers-102 + VOC2012 datasets (local-archive parsers, zero-egress).
+
+Parity: ``/root/reference/python/paddle/vision/datasets/flowers.py:77``
+(tgz of jpgs + scipy .mat labels/setid) and ``voc2012.py:89`` (single tar
+with ImageSets/Segmentation splits, JPEGImages, SegmentationClass).
+``download=True`` cannot fetch in this build — pass the local files, as
+the established paddle.vision convention here.
+
+The tar handle is opened lazily PER PROCESS (and excluded from pickling),
+so the datasets work under the spawn-based multiprocess DataLoader.
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Flowers", "VOC2012"]
+
+_MODE_FLAG = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+
+def _require(f, what, url):
+    if not f:
+        raise RuntimeError(
+            f"this build is zero-egress: pass {what}= pointing at a local "
+            f"copy ({url}); automatic download is unavailable")
+    return f
+
+
+def _check_backend(backend):
+    backend = backend or "pil"
+    if backend not in ("pil", "cv2"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2'], but got {backend}")
+    return backend
+
+
+class _TarBacked:
+    """Lazy tar access: handle opened on first use in EACH process."""
+
+    _tar_handle = None
+    _member_map = None
+
+    def _tar(self):
+        if self._tar_handle is None:
+            self._tar_handle = tarfile.open(self.data_file)
+            self._member_map = {m.name: m
+                                for m in self._tar_handle.getmembers()}
+        return self._tar_handle
+
+    def _read_member(self, name) -> bytes:
+        tar = self._tar()
+        return tar.extractfile(self._member_map[name]).read()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tar_handle"] = None
+        state["_member_map"] = None
+        return state
+
+    def __del__(self):
+        try:
+            if self._tar_handle is not None:
+                self._tar_handle.close()
+        except Exception:
+            pass
+
+
+class Flowers(_TarBacked, Dataset):
+    """Oxford 102 Flowers.  Items: (image, [label]) like the reference
+    (pil backend: PIL image; cv2 backend: float32 array)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        assert mode.lower() in ("train", "valid", "test"), mode
+        url = "https://www.robots.ox.ac.uk/~vgg/data/flowers/102/"
+        self.data_file = _require(data_file, "data_file", url + "102flowers.tgz")
+        label_file = _require(label_file, "label_file", url + "imagelabels.mat")
+        setid_file = _require(setid_file, "setid_file", url + "setid.mat")
+        self.transform = transform
+        self.backend = _check_backend(backend)
+
+        import scipy.io as scio
+
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[_MODE_FLAG[mode.lower()]][0]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]])
+        raw = self._read_member("jpg/image_%05d.jpg" % index)
+        image = Image.open(io.BytesIO(raw)).convert("RGB")
+        if self.backend == "cv2":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        if self.backend == "cv2":
+            image = np.asarray(image).astype("float32")
+        return image, label.astype("int64")
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(_TarBacked, Dataset):
+    """PASCAL VOC2012 segmentation.  Items: (image, segmentation mask).
+
+    Reference split semantics (voc2012.py MODE_FLAG_MAP): mode='train'
+    reads trainval.txt, 'valid' reads val.txt, 'test' reads train.txt.
+    """
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    _FLAG = {"train": "trainval", "valid": "val", "test": "train"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode.lower() in ("train", "valid", "test"), mode
+        self.data_file = _require(
+            data_file, "data_file",
+            "http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+            "VOCtrainval_11-May-2012.tar")
+        self.transform = transform
+        self.backend = _check_backend(backend)
+        self.flag = self._FLAG[mode.lower()]
+        split = self._read_member(self.SET_FILE.format(self.flag))
+        self.data, self.labels = [], []
+        for line in split.splitlines():
+            name = line.strip().decode("utf-8")
+            if not name:
+                continue
+            self.data.append(self.DATA_FILE.format(name))
+            self.labels.append(self.LABEL_FILE.format(name))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        data = Image.open(io.BytesIO(self._read_member(self.data[idx])))
+        label = Image.open(io.BytesIO(self._read_member(self.labels[idx])))
+        if self.backend == "cv2":
+            data, label = np.array(data), np.array(label)
+        if self.transform is not None:
+            data = self.transform(data)
+        if self.backend == "cv2":
+            return (np.asarray(data).astype("float32"),
+                    np.asarray(label).astype("float32"))
+        return data, label
+
+    def __len__(self):
+        return len(self.data)

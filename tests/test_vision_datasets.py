@@ -1,0 +1,129 @@
+"""Folder/Flowers/VOC2012 vision datasets (local files, zero-egress)."""
+
+import io as _io
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_folder_datasets(tmp_path):
+    from PIL import Image
+
+    root = tmp_path / "data"
+    for cls, color in [("cat", (255, 0, 0)), ("dog", (0, 255, 0))]:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.new("RGB", (8, 8), color).save(d / f"{i}.png")
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    ds = DatasetFolder(str(root))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert label == 0 and img.size == (8, 8)
+    assert ds.targets.count(1) == 3
+
+    flat = ImageFolder(str(root))
+    assert len(flat) == 6
+    (img2,) = flat[0]
+    assert img2.size == (8, 8)
+
+    ds2 = DatasetFolder(str(root), transform=lambda im: np.asarray(im))
+    arr, _ = ds2[0]
+    assert arr.shape == (8, 8, 3)
+
+
+def _flowers_fixture(tmp_path):
+    from PIL import Image
+    import scipy.io as scio
+
+    fdir = tmp_path / "flowers"
+    fdir.mkdir()
+    tar_p = str(fdir / "102flowers.tgz")
+    with tarfile.open(tar_p, "w:gz") as tf:
+        for i in range(1, 5):
+            buf = _io.BytesIO()
+            Image.new("RGB", (6, 6), (i * 40, 0, 0)).save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, _io.BytesIO(data))
+    lab_p = str(fdir / "imagelabels.mat")
+    set_p = str(fdir / "setid.mat")
+    scio.savemat(lab_p, {"labels": np.array([[1, 2, 1, 2]])})
+    scio.savemat(set_p, {"trnid": np.array([[1, 3]]),
+                         "valid": np.array([[2]]),
+                         "tstid": np.array([[4]])})
+    return tar_p, lab_p, set_p
+
+
+def test_flowers(tmp_path):
+    from paddle_tpu.vision.datasets import Flowers
+
+    tar_p, lab_p, set_p = _flowers_fixture(tmp_path)
+    ds = Flowers(data_file=tar_p, label_file=lab_p, setid_file=set_p,
+                 mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.size == (6, 6) and label.tolist() == [1]
+    # cv2 backend: float32 array (reference dtype cast)
+    dsc = Flowers(data_file=tar_p, label_file=lab_p, setid_file=set_p,
+                  mode="valid", backend="cv2")
+    arr, _ = dsc[0]
+    assert arr.dtype == np.float32 and arr.shape == (6, 6, 3)
+    with pytest.raises(ValueError):
+        Flowers(data_file=tar_p, label_file=lab_p, setid_file=set_p,
+                backend="CV2")
+
+
+def _to_float_array(im):
+    return np.asarray(im, "float32")
+
+
+def test_flowers_multiprocess_dataloader(tmp_path):
+    """Open tar handles must not break the spawn DataLoader (pickling)."""
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import Flowers
+
+    tar_p, lab_p, set_p = _flowers_fixture(tmp_path)
+    ds = Flowers(data_file=tar_p, label_file=lab_p, setid_file=set_p,
+                 mode="train", transform=_to_float_array)
+    ds[0]  # force the handle open BEFORE pickling
+    loader = DataLoader(ds, batch_size=2, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 1
+
+
+def test_voc2012(tmp_path):
+    from paddle_tpu.vision.datasets import VOC2012
+
+    from PIL import Image
+
+    voc_p = str(tmp_path / "voc.tar")
+    with tarfile.open(voc_p, "w") as tf:
+        def add(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, _io.BytesIO(data))
+
+        base = "VOCdevkit/VOC2012"
+        add(f"{base}/ImageSets/Segmentation/train.txt", b"a\n")
+        add(f"{base}/ImageSets/Segmentation/trainval.txt", b"a\nb\n")
+        add(f"{base}/ImageSets/Segmentation/val.txt", b"b\n")
+        for n in ("a", "b"):
+            buf = _io.BytesIO()
+            Image.new("RGB", (5, 5)).save(buf, format="JPEG")
+            add(f"{base}/JPEGImages/{n}.jpg", buf.getvalue())
+            buf = _io.BytesIO()
+            Image.new("P", (5, 5)).save(buf, format="PNG")
+            add(f"{base}/SegmentationClass/{n}.png", buf.getvalue())
+    # reference split semantics: train->trainval.txt, valid->val, test->train
+    assert len(VOC2012(data_file=voc_p, mode="train")) == 2
+    assert len(VOC2012(data_file=voc_p, mode="valid")) == 1
+    assert len(VOC2012(data_file=voc_p, mode="test")) == 1
+    im, mask = VOC2012(data_file=voc_p, mode="train")[1]
+    assert im.size == (5, 5) and mask.size == (5, 5)
