@@ -155,3 +155,33 @@ def test_hapi_model_fit_evaluate_predict(tmp_path):
         model.network.state_dict()["features.0.weight"].numpy(),
         model2.network.state_dict()["features.0.weight"].numpy(),
     )
+
+
+def test_reduce_lr_on_plateau_callback():
+    """hapi.callbacks.ReduceLROnPlateau: reduces the optimizer's float LR
+    after `patience` non-improving evals, with cooldown."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    net = nn.Linear(2, 1)
+    o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+
+    class FakeModel:
+        _optimizer = o
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0, cooldown=1)
+    cb.model = FakeModel()
+    cb.on_train_begin()
+    cb.on_eval_end({"loss": 1.0})       # best=1.0
+    assert abs(o.get_lr() - 0.1) < 1e-9
+    cb.on_eval_end({"loss": 1.0})       # wait=1
+    cb.on_eval_end({"loss": 1.0})       # wait=2 -> reduce
+    assert abs(o.get_lr() - 0.05) < 1e-9
+    cb.on_eval_end({"loss": 1.0})       # cooldown tick, no reduce
+    assert abs(o.get_lr() - 0.05) < 1e-9
+    cb.on_eval_end({"loss": 0.5})       # improvement resets wait
+    cb.on_eval_end({"loss": 0.5})
+    cb.on_eval_end({"loss": 0.5})
+    assert abs(o.get_lr() - 0.025) < 1e-9
