@@ -4,6 +4,13 @@ Parity: ``VarBase`` (`/root/reference/paddle/fluid/imperative/layer.h:66`) and
 its Python monkey-patches (`fluid/dygraph/varbase_patch_methods.py`,
 `math_op_patch.py`).  Most ``paddle.*`` tensor functions are attached as
 methods by :mod:`paddle_tpu.tensor_api` (math_op_patch parity).
+
+LoD note: the reference's ragged ``LoDTensor`` (``lod_tensor.h:109``) has
+no TPU-native equivalent on purpose — XLA requires static shapes, so
+variable-length data is carried as padded dense tensors + masks (the
+``sequence_mask`` op, masked criterions in ``models/``, and
+``paddle.text`` datasets returning per-item arrays the DataLoader pads);
+the ``LoDTensorArray`` surface lives in ``tensor_api.create_array`` et al.
 """
 
 from __future__ import annotations

@@ -12,7 +12,15 @@ TPU-first design
 ----------------
 One registry entry per op; the "kernel" is a pure JAX-traceable function
 ``kernel(ins, attrs) -> outs`` — there is no per-device kernel zoo because XLA
-is the only backend and handles CPU/TPU lowering itself.  Three consequences:
+is the only backend and handles CPU/TPU lowering itself.
+
+SelectedRows note: the reference represents embedding gradients as sparse
+row sets (``framework/selected_rows.h:41``) to avoid materializing a dense
+(vocab, h) gradient on the host.  Here embedding backward IS a dense
+scatter-add — but it exists only INSIDE the jitted step, where XLA fuses
+the scatter into the optimizer update and never round-trips it through
+host memory, so the dense form costs HBM bandwidth proportional to touched
+rows, not a host transfer.  Three further consequences of the design:
 
 * **Gradients are derived, not hand-written.**  For any registered op, the
   grad op ``<type>_grad`` is synthesized automatically from ``jax.vjp`` of the
