@@ -88,6 +88,8 @@ def main():
         #     HBM (gpt._chunked_softmax_xent);
         #   * per-op inner-jit boundaries guide XLA fusion (+4.4 MFU, see
         #     dygraph/tracer.run_eager_kernel);
+        #   * 512x512 flash tiles (kernels/flash._pick_block sweep: +8 MFU
+        #     over 128x128);
         #   * flagship runs WITHOUT remat — at 760M params + full AdamW
         #     state, batch 12 still fits v5e's 16G with the chunked CE.
         peak = 197e12  # v5e bf16 per chip
