@@ -4,7 +4,9 @@ Two measured points on TPU (round-3 verdict item 6):
   * flagship: GPT-760M (h=1536, L=24, 12x128d heads, seq 1024) — the
     largest config that fits one v5e chip with full AdamW state (bf16
     params + fp32 masters/moments) and chunked CE, no remat;
-  * small: GPT-150M (h=1024, L=12, 8x128d heads) — round-1/2 continuity.
+  * small: GPT-150M (h=1024, L=12, 8x128d heads) — round-1/2 continuity;
+  * long_seq: GPT-760M at seq 2048 — the long-context point (flash tiles
+    keep attention MXU-bound as the quadratic term grows).
 
 Prints ONE JSON line; the headline value/vs_baseline is the flagship
 config.  vs_baseline is measured MFU against the BASELINE.json north-star
@@ -103,6 +105,11 @@ def main():
                       num_heads=8, max_seq_len=1024, dropout=0.0),
             batch=24, seq=1024, steps=30, peak_flops=peak,
             dtype="bfloat16", remat=False, ce_rows=4096)
+        long_seq = _run(
+            GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
+                      num_heads=12, max_seq_len=2048, dropout=0.0),
+            batch=4, seq=2048, steps=8, peak_flops=peak,
+            dtype="bfloat16", remat=False, ce_rows=2048)
         head = flagship
     else:
         head = _run(
@@ -128,6 +135,7 @@ def main():
     }
     if small is not None:
         out["extra"]["small_config"] = small
+        out["extra"]["long_seq_config"] = long_seq
     print(json.dumps(out))
 
 
