@@ -108,8 +108,8 @@ def main():
         long_seq = _run(
             GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
                       num_heads=12, max_seq_len=2048, dropout=0.0),
-            batch=4, seq=2048, steps=8, peak_flops=peak,
-            dtype="bfloat16", remat=False, ce_rows=2048)
+            batch=6, seq=2048, steps=8, peak_flops=peak,
+            dtype="bfloat16", remat=False, ce_rows=1024)
         head = flagship
     else:
         head = _run(
