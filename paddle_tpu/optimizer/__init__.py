@@ -55,6 +55,10 @@ class Optimizer:
         self._grad_clip = grad_clip
         # accumulators: acc_name -> param_name -> Tensor (dygraph) / Variable (static)
         self._accumulators: Dict[str, Dict[str, object]] = {}
+        # state loaded before the owning accumulator exists (lazy creation);
+        # keyed by the serialized name ``{param}_{acc}_0`` and consumed by
+        # _add_accumulator (reference Optimizer._accumulators_holder)
+        self._accumulators_holder: Dict[str, object] = {}
         self._lr_var = None  # static-mode persistable lr var
         # fp16/bf16 params keep an fp32 master copy (reference multi_precision
         # adam: MasterParam in/out) — enabled by the optimizer arg or by
@@ -113,7 +117,15 @@ class Optimizer:
 
             from ..framework.dtype import to_jax_dtype
 
-            acc = Tensor(jnp.full(shape, fill_value, to_jax_dtype(dtype)), stop_gradient=True)
+            # Lazily apply state loaded before this accumulator existed
+            # (reference: Optimizer._add_accumulator reads
+            # _accumulators_holder) — set_state_dict on a fresh optimizer
+            # stashes snapshots here under the serialized key name.
+            held = self._pop_held(pname, name, to_jax_dtype(dtype), shape)
+            if held is not None:
+                acc = Tensor(held, stop_gradient=True)
+            else:
+                acc = Tensor(jnp.full(shape, fill_value, to_jax_dtype(dtype)), stop_gradient=True)
         else:
             block = fw.default_main_program().global_block()
             acc = block.create_var(
@@ -129,6 +141,17 @@ class Optimizer:
         store[pname] = acc
         return acc
 
+    def _pop_held(self, pname, acc_name, jax_dtype, shape=None):
+        """Consume a value stashed by set_state_dict for a not-yet-created
+        accumulator; returns a jnp array (cast/reshaped) or None."""
+        held = self._accumulators_holder.pop(f"{pname}_{acc_name}_0", None)
+        if held is None:
+            return None
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(held, jax_dtype)
+        return arr.reshape(shape) if shape is not None else arr
+
     # -- fp32 master weights (multi_precision parity) ----------------------
     def _master_weight(self, p):
         """fp32 master copy for a low-precision param (created from the
@@ -139,7 +162,13 @@ class Optimizer:
         store = self._accumulators.setdefault("master_weight", {})
         mw = store.get(p.name)
         if mw is None:
-            mw = Tensor(p._array.astype(jnp.float32), stop_gradient=True)
+            # a checkpointed fp32 master loaded before this param's first
+            # step must win over an upcast of the (lossy) low-precision param
+            held = self._pop_held(p.name, "master_weight", jnp.float32)
+            if held is not None:
+                mw = Tensor(held, stop_gradient=True)
+            else:
+                mw = Tensor(p._array.astype(jnp.float32), stop_gradient=True)
             mw.name = p.name  # alias so per-param accumulators keep their keys
             store[p.name] = mw
         return mw
@@ -218,6 +247,30 @@ class Optimizer:
         params_grads = self._apply_clip(params_grads)
         for p, g in params_grads:
             self._apply_optimize_op(p, g)
+        if self._accumulators_holder:
+            # Surface held state that can no longer be consumed, instead of
+            # silently training from zeroed accumulators: keys for unknown
+            # params, and keys for params that just stepped (their
+            # accumulators were created above, so an unconsumed key means
+            # this optimizer class never creates that accumulator — e.g. an
+            # Adam checkpoint loaded into Momentum).  Keys for owned params
+            # that had no grad this step stay held.
+            owned = {p.name for p in (self._parameter_list or [])}
+            stepped = {p.name for p, _ in params_grads}
+            orphans = []
+            for k in list(self._accumulators_holder):
+                owner = next((n for n in owned if k.startswith(n + "_")), None)
+                if owner is None or owner in stepped:
+                    orphans.append(k)
+                    self._accumulators_holder.pop(k)
+            if orphans:
+                import warnings
+
+                warnings.warn(
+                    f"optimizer.set_state_dict: {len(orphans)} loaded key(s) "
+                    f"could not be applied to this optimizer and were "
+                    f"ignored: {sorted(orphans)[:8]}"
+                    + ("..." if len(orphans) > 8 else ""))
 
     def clear_grad(self):
         if self._parameter_list:
@@ -291,14 +344,31 @@ class Optimizer:
             tgt = self._find_accumulator(key)
             if tgt is not None and isinstance(tgt, Tensor):
                 tgt.set_value(val.numpy() if hasattr(val, "numpy") else val)
+            elif fw.in_dygraph_mode() and tgt is None:
+                # Accumulators are created lazily on the first step(); stash
+                # the value so _add_accumulator initializes from it later
+                # (reference Optimizer._accumulators_holder behavior).
+                # Normalize the legacy round-1 ``{param}/{acc}`` form to the
+                # serialized ``{param}_{acc}_0`` key _add_accumulator pops.
+                if "/" in key:
+                    pname, acc_name = key.rsplit("/", 1)
+                    key = f"{pname}_{acc_name}_0"
+                # snapshot now — ``val`` may be a live Tensor whose buffer
+                # the source optimizer keeps rebinding on step()
+                import numpy as np
+
+                self._accumulators_holder[key] = np.array(
+                    val.numpy() if hasattr(val, "numpy") else val)
             else:
+                # static mode (accumulators are scope Variables, restored via
+                # load_program_state) or an existing non-Tensor target
                 unmatched.append(key)
         if unmatched:
             import warnings
 
             warnings.warn(
-                f"optimizer.set_state_dict: {len(unmatched)} key(s) did not "
-                f"match any accumulator and were ignored: {unmatched[:8]}"
+                f"optimizer.set_state_dict: {len(unmatched)} key(s) could "
+                f"not be applied and were ignored: {unmatched[:8]}"
                 + ("..." if len(unmatched) > 8 else ""))
 
     set_dict = set_state_dict

@@ -165,6 +165,52 @@ def test_optimizer_state_dict_reference_keys():
     np.testing.assert_allclose(
         np.asarray(o2._accumulators["moment1"][wname]._array),
         np.asarray(o._accumulators["moment1"][wname]._array))
-    # unknown keys warn instead of silently dropping
-    with pytest.warns(UserWarning, match="did not match"):
-        o2.set_state_dict({"nonexistent_param_moment1_0": sd[f"{wname}_moment1_0"]})
+    # keys with no existing accumulator are stashed, not dropped: loading
+    # into a FRESH optimizer (no step yet, lazy accumulators) must still
+    # restore state once the accumulators are created on first step
+    # (reference Optimizer._accumulators_holder).
+    o3 = opt.Adam(0.01, parameters=net.parameters())
+    o3.set_state_dict({k: v for k, v in sd.items()})
+    assert f"{wname}_moment1_0" in o3._accumulators_holder
+    net(x).sum().backward()
+    o3.step()  # accumulators created here, seeded from the held state
+    o3.clear_grad()
+    # o (one more step from sd) and o3 (loaded sd, then one step) see the
+    # same gradient (d sum(xW+b)/dW is W-independent), so moments match
+    net(x).sum().backward()
+    o.step()
+    o.clear_grad()
+    np.testing.assert_allclose(
+        np.asarray(o3._accumulators["moment1"][wname]._array),
+        np.asarray(o._accumulators["moment1"][wname]._array), rtol=1e-6)
+    # keys that can never match any owned param are reported at step time
+    o3.set_state_dict({"nonexistent_param_moment1_0": sd[f"{wname}_moment1_0"]})
+    net(x).sum().backward()
+    with pytest.warns(UserWarning, match="could not be applied"):
+        o3.step()
+    o3.clear_grad()
+
+
+def test_master_weight_lazy_restore():
+    """A checkpointed fp32 master weight must survive a resume into a fresh
+    multi_precision optimizer (not be rebuilt by upcasting the bf16 param)."""
+    paddle.seed(0)
+    net = nn.Linear(3, 3)
+    for p in net.parameters():
+        p._array = p._array.astype("bfloat16")
+    o = opt.Adam(0.01, parameters=net.parameters(), multi_precision=True)
+    x = paddle.to_tensor(np.ones((2, 3), "bfloat16"))
+    net(x).sum().backward()
+    o.step()
+    o.clear_grad()
+    sd = o.state_dict()
+    wname = net.weight.name
+    assert f"{wname}_master_weight_0" in sd
+    master_saved = np.asarray(
+        o._accumulators["master_weight"][wname]._array, "float32")
+    o2 = opt.Adam(0.01, parameters=net.parameters(), multi_precision=True)
+    o2.set_state_dict(sd)
+    mw = o2._master_weight(net.weight)  # first touch consumes the held value
+    np.testing.assert_array_equal(np.asarray(mw._array), master_saved)
+    # and NOT equal to a plain upcast of the lossy bf16 param (generically)
+    assert f"{wname}_master_weight_0" not in o2._accumulators_holder
