@@ -15,3 +15,6 @@ from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
 )
 from .generation import build_generate_fn, generate  # noqa: F401
+from .rec import (  # noqa: F401
+    RecConfig, DeepFM, WideDeep, FusedSparseEmbedding, synthetic_click_batch,
+)

@@ -1,0 +1,194 @@
+"""Recommendation models: DeepFM and wide&deep on the collective path.
+
+Role parity: BASELINE.json config 4 (PaddleRec DeepFM / wide_deep, "sparse
+embedding, stretch collective path").  The reference serves these workloads
+through the parameter-server stack (``paddle/fluid/distributed/``,
+``operators/pscore/distributed_lookup_table_op``); the BASELINE north star
+leaves the PS path untouched and routes sparse models through the
+collective path instead — embedding tables live on-device, sharded over a
+mesh axis the way ``operators/collective/c_embedding`` / Megatron
+VocabParallelEmbedding shard a vocab
+(``fleet/meta_parallel/parallel_layers/mp_layers.py:30``).
+
+TPU-first design decisions (vs the reference's PS lookup):
+
+- **One fused table, one gather.**  All categorical fields share a single
+  ``[total_vocab, dim]`` table; per-field ids are offset by static
+  ``field_offsets`` so a whole ``[batch, num_fields]`` id matrix becomes ONE
+  XLA gather.  The reference does a brpc ``pull_sparse`` RPC per table —
+  here the "lookup" is on-chip HBM reads that XLA fuses into the downstream
+  compute, and sharding the rows over a mesh axis makes the gather a
+  collective-backed distributed lookup (the `c_embedding` role) with zero
+  extra code.
+- **Dense gradients.**  SelectedRows sparse grads exist in the reference to
+  keep PS push traffic proportional to touched rows; under XLA the
+  scatter-add that materializes the dense grad is fused and HBM-local, and
+  the optimizer update over the sharded table rides the same mesh axis
+  (see ``ops/registry.py`` auto-vjp note).
+- **FM second-order in O(b·f·d)** via the sum-square identity rather than
+  pairwise interactions, keeping the hot math in batched matmul/elementwise
+  form the MXU/VPU like.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from .. import tensor_api as T
+from ..nn.initializer import Normal
+from ..distributed.fleet.meta_parallel.mp_layers import _place, _mp_degree
+
+
+@dataclasses.dataclass
+class RecConfig:
+    """Shared config for the sparse models.
+
+    ``field_vocab_sizes[i]`` is the vocabulary of categorical field ``i``
+    (ids fed in ``[0, field_vocab_sizes[i])``); ``dense_dim`` is the number
+    of continuous features.
+    """
+
+    field_vocab_sizes: Sequence[int] = (1000,) * 26
+    dense_dim: int = 13
+    embedding_dim: int = 16
+    hidden_sizes: Sequence[int] = (400, 400, 400)
+    shard_axis: Optional[str] = "mp"  # mesh axis for table rows (None = replicate)
+
+    @property
+    def num_fields(self) -> int:
+        return len(self.field_vocab_sizes)
+
+    @property
+    def total_vocab(self) -> int:
+        return int(sum(self.field_vocab_sizes))
+
+    def offsets(self) -> np.ndarray:
+        return np.cumsum([0] + list(self.field_vocab_sizes)[:-1]).astype("int32")
+
+
+class FusedSparseEmbedding(nn.Layer):
+    """All fields' embeddings in one row-sharded table, one gather.
+
+    The distributed-lookup role of ``distributed_lookup_table_op`` /
+    ``c_embedding``: rows sharded over ``cfg.shard_axis``, gather lowered by
+    GSPMD into a sharded lookup with the collective on the output.
+    """
+
+    def __init__(self, cfg: RecConfig, dim: Optional[int] = None,
+                 init_std: float = 0.01):
+        super().__init__()
+        self._cfg = cfg
+        dim = cfg.embedding_dim if dim is None else dim
+        self.weight = self.create_parameter(
+            shape=[cfg.total_vocab, dim],
+            default_initializer=Normal(0.0, init_std),
+        )
+        if cfg.shard_axis:
+            _place(self.weight, cfg.shard_axis, None)
+            self.weight.is_distributed = _mp_degree() > 1
+        # static per-field row offsets, folded into the ids at trace time
+        # (materialized once; reused every forward)
+        self._offsets = T.to_tensor(cfg.offsets())
+
+    def forward(self, sparse_ids):
+        # [b, f] local ids -> [b, f] global rows -> [b, f, dim]
+        return F.embedding(sparse_ids + self._offsets, self.weight)
+
+
+class _MLP(nn.Layer):
+    def __init__(self, in_dim: int, hidden: Sequence[int], out_dim: int = 1):
+        super().__init__()
+        layers: List[nn.Layer] = []
+        d = in_dim
+        for h in hidden:
+            layers += [nn.Linear(d, h), nn.ReLU()]
+            d = h
+        layers.append(nn.Linear(d, out_dim))
+        self.net = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class DeepFM(nn.Layer):
+    """DeepFM (Guo et al. 2017): FM first+second order + deep tower.
+
+    Returns logits ``[batch, 1]``; train with
+    ``F.binary_cross_entropy_with_logits``.
+    """
+
+    def __init__(self, cfg: RecConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embedding = FusedSparseEmbedding(cfg)
+        # first-order weights: a dim-1 embedding over the same fused vocab
+        self.fo_weight = FusedSparseEmbedding(cfg, dim=1)
+        self.dense_fo = nn.Linear(cfg.dense_dim, 1)
+        # dense features also join the FM pairwise term via a projection
+        # into embedding space (standard Criteo DeepFM formulation)
+        self.dense_emb = nn.Linear(cfg.dense_dim, cfg.embedding_dim)
+        self.deep = _MLP(
+            cfg.num_fields * cfg.embedding_dim + cfg.dense_dim,
+            cfg.hidden_sizes)
+
+    def forward(self, sparse_ids, dense_feats):
+        b = sparse_ids.shape[0]
+        emb = self.embedding(sparse_ids)                      # [b, f, d]
+        # first order
+        first = T.sum(self.fo_weight(sparse_ids), axis=[1, 2], keepdim=False)
+        first = T.reshape(first, [b, 1]) + self.dense_fo(dense_feats)
+        # second order over fields + projected dense: 0.5*((Σe)² − Σe²)
+        dvec = T.reshape(self.dense_emb(dense_feats), [b, 1, -1])
+        allv = T.concat([emb, dvec], axis=1)                  # [b, f+1, d]
+        s = T.sum(allv, axis=1)                               # [b, d]
+        s2 = T.sum(allv * allv, axis=1)                       # [b, d]
+        second = 0.5 * T.sum(s * s - s2, axis=1, keepdim=True)
+        # deep tower
+        deep_in = T.concat([T.reshape(emb, [b, -1]), dense_feats], axis=1)
+        return first + second + self.deep(deep_in)
+
+
+class WideDeep(nn.Layer):
+    """wide&deep (Cheng et al. 2016): linear wide part + MLP deep part."""
+
+    def __init__(self, cfg: RecConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embedding = FusedSparseEmbedding(cfg)
+        self.wide = FusedSparseEmbedding(cfg, dim=1)          # sparse linear
+        self.wide_dense = nn.Linear(cfg.dense_dim, 1)
+        self.deep = _MLP(
+            cfg.num_fields * cfg.embedding_dim + cfg.dense_dim,
+            cfg.hidden_sizes)
+
+    def forward(self, sparse_ids, dense_feats):
+        b = sparse_ids.shape[0]
+        wide = T.reshape(
+            T.sum(self.wide(sparse_ids), axis=[1, 2], keepdim=False), [b, 1]
+        ) + self.wide_dense(dense_feats)
+        emb = self.embedding(sparse_ids)
+        deep_in = T.concat([T.reshape(emb, [b, -1]), dense_feats], axis=1)
+        return wide + self.deep(deep_in)
+
+
+def synthetic_click_batch(cfg: RecConfig, batch: int, seed: int = 0):
+    """Synthetic Criteo-like batch with a learnable signal: the label
+    correlates with a random per-row score of the sampled ids, so loss/AUC
+    measurably improve within a few steps (used by the example + tests)."""
+    rs = np.random.RandomState(seed)
+    ids = np.stack(
+        [rs.randint(0, v, size=batch) for v in cfg.field_vocab_sizes],
+        axis=1).astype("int32")
+    dense = rs.rand(batch, cfg.dense_dim).astype("float32")
+    # hidden ground-truth: each vocab row carries a latent logit
+    hidden = np.random.RandomState(1234)
+    row_logit = hidden.randn(cfg.total_vocab).astype("float32") * 0.5
+    glob = ids + cfg.offsets()[None, :]
+    logit = row_logit[glob].sum(axis=1) + dense.sum(axis=1) - cfg.dense_dim / 2
+    label = (1 / (1 + np.exp(-logit)) > rs.rand(batch)).astype("float32")
+    return ids, dense, label[:, None]

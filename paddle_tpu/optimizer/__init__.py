@@ -259,7 +259,10 @@ class Optimizer:
             stepped = {p.name for p, _ in params_grads}
             orphans = []
             for k in list(self._accumulators_holder):
-                owner = next((n for n in owned if k.startswith(n + "_")), None)
+                # longest-prefix match: with params 'emb' and 'emb_2', key
+                # 'emb_2_moment1_0' must attribute to 'emb_2', not 'emb'
+                owner = max((n for n in owned if k.startswith(n + "_")),
+                            key=len, default=None)
                 if owner is None or owner in stepped:
                     orphans.append(k)
                     self._accumulators_holder.pop(k)
@@ -313,6 +316,9 @@ class Optimizer:
         ``{param}_{acc}_0`` (e.g. ``linear_0.w_0_moment1_0``) so .pdopt files
         interchange with reference-produced checkpoints."""
         d = {}
+        # state loaded but not yet consumed (no step since set_state_dict)
+        # must survive a save — otherwise checkpoint-after-load drops it
+        d.update(self._accumulators_holder)
         for acc_name, store in self._accumulators.items():
             for pname, acc in store.items():
                 d[f"{pname}_{acc_name}_0"] = acc
