@@ -41,7 +41,7 @@ from .. import nn
 from ..nn import functional as F
 from .. import tensor_api as T
 from ..nn.initializer import Normal
-from ..distributed.fleet.meta_parallel.mp_layers import _place, _mp_degree
+from ..distributed.fleet.meta_parallel.mp_layers import _place
 
 
 @dataclasses.dataclass
@@ -89,8 +89,13 @@ class FusedSparseEmbedding(nn.Layer):
             default_initializer=Normal(0.0, init_std),
         )
         if cfg.shard_axis:
+            from ..distributed import mesh as mesh_mod
+
             _place(self.weight, cfg.shard_axis, None)
-            self.weight.is_distributed = _mp_degree() > 1
+            # is_distributed gates the DP wrapper's grad allreduce; it must
+            # key off whatever axis actually shards the rows
+            self.weight.is_distributed = (
+                mesh_mod.axis_size(cfg.shard_axis) > 1)
         # static per-field row offsets, folded into the ids at trace time
         # (materialized once; reused every forward)
         self._offsets = T.to_tensor(cfg.offsets())
