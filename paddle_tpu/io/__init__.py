@@ -5,10 +5,14 @@ Parity: ``/root/reference/python/paddle/fluid/reader.py`` (DataLoader:146),
 multi-process:248 with shared-memory IPC, worker.py, batch_sampler.py,
 collate.py, dataset.py).
 
-TPU-first: the multiprocess path ships numpy batches over a queue and the
-main process stages them to device (jnp.asarray) — double-buffered like the
-reference's buffered_reader.cc.  A C shared-memory ring (csrc/) replaces
-pickle transport for large batches when built (mmap_allocator parity).
+TPU-first: the multiprocess path ships batch control messages over a queue
+and the bulk array payloads through a C++ shared-memory slot ring
+(``csrc/shm_ring.cc``, compiled on first use; the mmap_allocator /
+LoDTensorBlockingQueue role) — pickle-5 out-of-band buffers, one memcpy per
+batch each way.  The main process stages batches to device (jnp.asarray),
+double-buffered like the reference's buffered_reader.cc.  Queue pickling
+remains the fallback when no compiler is available or a batch exceeds the
+slot size (PADDLE_SHM_SLOT_MB, default 64).
 """
 
 from __future__ import annotations
@@ -294,13 +298,21 @@ def _to_device(batch, return_list=True):
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 num_workers, worker_init_fn):
+                 num_workers, worker_init_fn, shm_name=None, shm_so=None):
     """Parity: fluid/dataloader/worker.py _worker_loop (spawn + queue IPC).
-    Large-batch shared-memory transport lands with the C ring buffer (csrc/);
-    until then batches ship pickled through the queue."""
+
+    Bulk transport: when the C++ shm ring (csrc/shm_ring.cc) is available,
+    each batch's array buffers go out-of-band through a shared-memory slot
+    (one memcpy; mmap_allocator role) and only a tiny control message rides
+    the queue; otherwise the whole batch is pickled through the queue."""
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
+    ring = None
+    if shm_name is not None:
+        from . import shm_ring as _sr
+
+        ring = _sr.ShmRing.attach(shm_name, shm_so)
     while True:
         item = index_queue.get()
         if item is None:
@@ -308,13 +320,19 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         seq, indices = item
         try:
             batch = collate_fn([dataset[i] for i in indices])
-            data_queue.put((seq, batch))
+            slot = ring.put(batch) if ring is not None else None
+            if slot is not None:
+                data_queue.put((seq, worker_id, "shm", slot))
+            else:
+                data_queue.put((seq, worker_id, "pkl", batch))
         except Exception as e:  # ship the error to the main process
             import traceback
 
-            data_queue.put((seq, RuntimeError(
+            data_queue.put((seq, worker_id, "err", RuntimeError(
                 f"DataLoader worker {worker_id} failed: {e}\n{traceback.format_exc()}"
             )))
+    if ring is not None:
+        ring.close()
 
 
 class DataLoader:
@@ -334,7 +352,7 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
-        self._pool = None  # (index_queues, data_queue, workers) when persistent
+        self._pool = None  # (index_queues, data_queue, workers, rings) when persistent
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_size = batch_size
@@ -386,26 +404,48 @@ class DataLoader:
         ctx = mp.get_context("spawn")
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         data_queue = ctx.Queue()
+        rings = {}
+        shm_so = None
+        if self.use_shared_memory:
+            from . import shm_ring as _sr
+
+            shm_so = _sr.lib_path()
         workers = []
         for wid in range(self.num_workers):
+            shm_name = None
+            if shm_so is not None:
+                from . import shm_ring as _sr
+
+                slot_mb = int(os.environ.get("PADDLE_SHM_SLOT_MB", "64"))
+                shm_name = f"/pt_dl_{os.getpid()}_{id(self)}_{wid}"
+                ring = _sr.ShmRing.create(
+                    shm_name, nslots=self.prefetch_factor + 2,
+                    slot_bytes=slot_mb << 20)
+                if ring is None:
+                    shm_name = None
+                else:
+                    rings[wid] = ring
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[wid], data_queue,
-                      self.collate_fn, wid, self.num_workers, self.worker_init_fn),
+                      self.collate_fn, wid, self.num_workers,
+                      self.worker_init_fn, shm_name, shm_so),
                 daemon=True,
             )
             w.start()
             workers.append(w)
-        return index_queues, data_queue, workers
+        return index_queues, data_queue, workers, rings
 
     def _shutdown_pool(self, pool):
-        index_queues, _, workers = pool
+        index_queues, _, workers, rings = pool
         for q in index_queues:
             q.put(None)
         for w in workers:
             w.join(timeout=1)
             if w.is_alive():
                 w.terminate()
+        for r in rings.values():
+            r.close()
 
     def __del__(self):
         if self._pool is not None:
@@ -419,13 +459,13 @@ class DataLoader:
         if self.persistent_workers:
             if self._pool is None:
                 self._pool = self._spawn_pool()
-            index_queues, data_queue, workers = self._pool
+            index_queues, data_queue, workers, rings = self._pool
         else:
-            index_queues, data_queue, workers = self._spawn_pool()
+            index_queues, data_queue, workers, rings = self._spawn_pool()
+        inflight = 0
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
-            inflight = 0
             next_send = 0
             # prefetch_factor batches per worker in flight
             max_inflight = self.prefetch_factor * self.num_workers
@@ -438,14 +478,30 @@ class DataLoader:
                     )
                     next_send += 1
                     inflight += 1
-                seq, payload = data_queue.get(timeout=self.timeout)
+                seq, wid, kind, payload = data_queue.get(timeout=self.timeout)
                 inflight -= 1
-                if isinstance(payload, Exception):
+                if kind == "err":
                     raise payload
+                if kind == "shm":
+                    payload = rings[wid].get(payload)
                 reorder[seq] = payload
                 while next_yield in reorder:
                     yield _to_device(reorder.pop(next_yield), self.return_list)
                     next_yield += 1
         finally:
             if not self.persistent_workers:
-                self._shutdown_pool((index_queues, data_queue, workers))
+                self._shutdown_pool((index_queues, data_queue, workers, rings))
+            elif inflight > 0:
+                # epoch abandoned mid-flight (break / worker error): drain the
+                # stale messages so the next epoch's seq numbering can't
+                # collide with them, and release their shm slots so the ring
+                # doesn't leak BUSY slots
+                while inflight > 0:
+                    try:
+                        _, wid, kind, payload = data_queue.get(
+                            timeout=self.timeout)
+                    except queue.Empty:
+                        break
+                    inflight -= 1
+                    if kind == "shm":
+                        rings[wid].get(payload)

@@ -1,0 +1,180 @@
+"""ctypes binding for the C++ shared-memory slot ring (csrc/shm_ring.cc).
+
+DataLoader workers serialize each batch with pickle protocol 5 and ship the
+bulk array buffers OUT-OF-BAND through a shm slot: the pickle stream holds
+only structure + small scalars, every ndarray payload is one memcpy into
+shared memory (mmap_allocator role — see shm_ring.cc header).  Falls back
+to queue pickling when the compiler is unavailable or a batch exceeds the
+slot size.
+
+Slot wire format: [u64 pickle_len][pickle bytes][u64 nbuf]
+                  ([u64 buf_len][raw bytes]) * nbuf
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+from typing import Optional
+
+_LIB = None
+_LIB_PATH: Optional[str] = None
+_BUILD_ERR: Optional[str] = None
+
+
+def _source_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc", "shm_ring.cc")
+
+
+def _build() -> Optional[str]:
+    """Compile (content-hash cached) and return the .so path, or None.
+    A failed build is negatively cached — no per-epoch g++ retries."""
+    global _BUILD_ERR
+    if _BUILD_ERR is not None:
+        return None
+    from ..utils.cpp_extension import compile_cached
+
+    try:
+        return compile_cached("shm_ring", [_source_path()],
+                              extra_ldflags=["-lrt"])
+    except (RuntimeError, OSError) as e:  # no g++ / compile failure
+        _BUILD_ERR = str(e)[-1000:]
+        return None
+
+
+def _bind(path: str):
+    lib = ctypes.CDLL(path)
+    lib.srb_create.restype = ctypes.c_void_p
+    lib.srb_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                               ctypes.c_uint64]
+    lib.srb_attach.restype = ctypes.c_void_p
+    lib.srb_attach.argtypes = [ctypes.c_char_p]
+    lib.srb_acquire.restype = ctypes.c_int
+    lib.srb_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.srb_data.restype = ctypes.POINTER(ctypes.c_ubyte)
+    lib.srb_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.srb_slot_bytes.restype = ctypes.c_uint64
+    lib.srb_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.srb_nslots.restype = ctypes.c_uint32
+    lib.srb_nslots.argtypes = [ctypes.c_void_p]
+    lib.srb_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.srb_close.argtypes = [ctypes.c_void_p]
+    lib.srb_unlink.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+def get_lib(path: Optional[str] = None):
+    """Load (building if needed) the ring library; None if unavailable."""
+    global _LIB, _LIB_PATH
+    if _LIB is not None:
+        return _LIB
+    p = path or _LIB_PATH or _build()
+    if p is None:
+        return None
+    try:
+        _LIB = _bind(p)
+        _LIB_PATH = p
+    except OSError:
+        return None
+    return _LIB
+
+
+def lib_path() -> Optional[str]:
+    """The built .so path (for handing to spawned workers)."""
+    if _LIB_PATH is None:
+        get_lib()
+    return _LIB_PATH
+
+
+class ShmRing:
+    """One shm slot arena (any number of producers, one consumer)."""
+
+    def __init__(self, name: str, handle, lib, owner: bool):
+        self.name = name
+        self._h = handle
+        self._lib = lib
+        self._owner = owner
+        self.slot_bytes = int(lib.srb_slot_bytes(handle))
+
+    # -- lifecycle --------------------------------------------------------
+    @classmethod
+    def create(cls, name: str, nslots: int, slot_bytes: int,
+               lib=None) -> Optional["ShmRing"]:
+        lib = lib or get_lib()
+        if lib is None:
+            return None
+        h = lib.srb_create(name.encode(), nslots, slot_bytes)
+        return cls(name, h, lib, owner=True) if h else None
+
+    @classmethod
+    def attach(cls, name: str, so_path: Optional[str] = None
+               ) -> Optional["ShmRing"]:
+        lib = get_lib(so_path)
+        if lib is None:
+            return None
+        h = lib.srb_attach(name.encode())
+        return cls(name, h, lib, owner=False) if h else None
+
+    def close(self):
+        if self._h:
+            self._lib.srb_close(self._h)
+            if self._owner:
+                self._lib.srb_unlink(self.name.encode())
+            self._h = None
+
+    # -- transport --------------------------------------------------------
+    def put(self, obj, timeout_ms: int = 10000) -> Optional[int]:
+        """Serialize ``obj`` into a free slot; returns the slot index, or
+        None when the payload doesn't fit / no slot frees up in time (caller
+        falls back to queue pickling)."""
+        try:
+            bufs = []
+            pick = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+            views = [b.raw() for b in bufs]
+        except (BufferError, pickle.PicklingError):
+            return None  # non-contiguous / unpicklable: queue fallback
+        total = (8 + len(pick) + 8
+                 + sum(8 + v.nbytes for v in views))
+        if total > self.slot_bytes:
+            return None
+        slot = self._lib.srb_acquire(self._h, timeout_ms)
+        if slot < 0:
+            return None
+        dst = self._lib.srb_data(self._h, slot)
+        mv = memoryview(ctypes.cast(
+            dst, ctypes.POINTER(ctypes.c_ubyte * self.slot_bytes)).contents
+        ).cast("B")
+        off = 0
+        mv[off:off + 8] = struct.pack("<Q", len(pick)); off += 8
+        mv[off:off + len(pick)] = pick; off += len(pick)
+        mv[off:off + 8] = struct.pack("<Q", len(views)); off += 8
+        for v in views:
+            flat = v.cast("B") if v.ndim != 1 or v.format != "B" else v
+            n = flat.nbytes
+            mv[off:off + 8] = struct.pack("<Q", n); off += 8
+            mv[off:off + n] = flat; off += n
+        return slot
+
+    def get(self, slot: int):
+        """Deserialize the object in ``slot`` and free the slot."""
+        src = self._lib.srb_data(self._h, slot)
+        mv = memoryview(ctypes.cast(
+            src, ctypes.POINTER(ctypes.c_ubyte * self.slot_bytes)).contents
+        ).cast("B")
+        try:
+            off = 0
+            (plen,) = struct.unpack_from("<Q", mv, off); off += 8
+            pick = bytes(mv[off:off + plen]); off += plen
+            (nbuf,) = struct.unpack_from("<Q", mv, off); off += 8
+            bufs = []
+            for _ in range(nbuf):
+                (n,) = struct.unpack_from("<Q", mv, off); off += 8
+                # copy out so the slot can be recycled immediately
+                bufs.append(bytes(mv[off:off + n])); off += n
+            return pickle.loads(pick, buffers=bufs)
+        finally:
+            del mv
+            self._lib.srb_release(self._h, slot)

@@ -228,36 +228,42 @@ def load_op_library(path: str):
     return ns
 
 
-def load(name: str, sources: Sequence[str], extra_cflags: Optional[list]
-         = None, extra_include_paths: Optional[list] = None,
-         build_directory: Optional[str] = None, verbose: bool = False):
-    """Parity: ``paddle.utils.cpp_extension.load`` — compile user C++
-    sources into a shared library with g++ and register the exported ops.
-    Recompiles only when sources change (content-hash build cache)."""
+def compile_cached(name: str, sources: Sequence[str],
+                   extra_cflags: Optional[list] = None,
+                   extra_include_paths: Optional[list] = None,
+                   extra_ldflags: Optional[list] = None,
+                   hash_extra_files: Optional[list] = None,
+                   build_directory: Optional[str] = None,
+                   verbose: bool = False) -> str:
+    """Compile C++ sources to a shared library with a content-hash build
+    cache; returns the .so path.  Shared by :func:`load` (custom ops) and
+    the DataLoader shm-ring transport (``io/shm_ring.py``).
+
+    Raises RuntimeError on compile failure and OSError/FileNotFoundError
+    when no compiler exists — callers that have a fallback catch those."""
     build_dir = build_directory or os.path.join(
         tempfile.gettempdir(), "paddle_tpu_extensions")
     os.makedirs(build_dir, exist_ok=True)
     h = hashlib.sha1()
-    header = os.path.join(get_include(), "paddle_tpu_ext.h")
-    for src in list(sources) + [header]:
+    for src in list(sources) + list(hash_extra_files or []):
         with open(src, "rb") as f:
             h.update(f.read())
     h.update(repr((sorted(extra_cflags or []),
-                   sorted(extra_include_paths or []))).encode())
+                   sorted(extra_include_paths or []),
+                   sorted(extra_ldflags or []))).encode())
     so_path = os.path.join(build_dir, f"lib{name}_{h.hexdigest()[:12]}.so")
     if not os.path.exists(so_path):
-        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++14",
-               f"-I{get_include()}"]
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++14"]
         for inc in extra_include_paths or []:
             cmd.append(f"-I{inc}")
         cmd += list(extra_cflags or [])
         cmd += [os.path.abspath(s) for s in sources]
-        cmd += ["-o", so_path]
         # compile to a temp name + atomic rename: an interrupted/concurrent
         # g++ must never leave a half-written .so that later loads treat as
         # a valid cache hit
         tmp_path = f"{so_path}.tmp.{os.getpid()}"
-        cmd[-1] = tmp_path
+        cmd += ["-o", tmp_path]
+        cmd += list(extra_ldflags or [])
         if verbose:
             print("cpp_extension:", " ".join(cmd), file=sys.stderr)
         proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -265,4 +271,19 @@ def load(name: str, sources: Sequence[str], extra_cflags: Optional[list]
             raise RuntimeError(
                 f"cpp_extension build failed:\n{proc.stderr[-4000:]}")
         os.replace(tmp_path, so_path)
+    return so_path
+
+
+def load(name: str, sources: Sequence[str], extra_cflags: Optional[list]
+         = None, extra_include_paths: Optional[list] = None,
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """Parity: ``paddle.utils.cpp_extension.load`` — compile user C++
+    sources into a shared library with g++ and register the exported ops.
+    Recompiles only when sources change (content-hash build cache)."""
+    header = os.path.join(get_include(), "paddle_tpu_ext.h")
+    so_path = compile_cached(
+        name, sources, extra_cflags=extra_cflags,
+        extra_include_paths=[get_include()] + list(extra_include_paths or []),
+        hash_extra_files=[header], build_directory=build_directory,
+        verbose=verbose)
     return load_op_library(so_path)
