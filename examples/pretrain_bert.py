@@ -1,11 +1,13 @@
 """BASELINE config 2: BERT-base / ERNIE-style pretraining, end to end.
 
-Runs MLM+NSP pretraining with synthetic data (the input pipeline is
-interchangeable; the compute path is the real one): BertForPretraining +
-BertPretrainingCriterion + AdamW with warmup-decay LR and global-norm clip,
-batch sharded over the 'dp'(+'sharding') mesh axes when a mesh is up.
+Runs MLM+NSP (BERT) or MLM+SOP (ERNIE, --model ernie) pretraining with
+synthetic data (the input pipeline is interchangeable; the compute path is
+the real one): {Bert,Ernie}ForPretraining + the matching criterion + AdamW
+with warmup-decay LR and global-norm clip, batch sharded over the
+'dp'(+'sharding') mesh axes when a mesh is up.
 
     python examples/pretrain_bert.py --steps 20 --hidden 256 --layers 4
+    python examples/pretrain_bert.py --model ernie --steps 20
     python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
         examples/pretrain_bert.py --steps 5       # DP over two processes
 """
@@ -30,6 +32,7 @@ def main():
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--vocab", type=int, default=8192)
     p.add_argument("--masked", type=int, default=20, help="masked tokens/seq")
+    p.add_argument("--model", choices=["bert", "ernie"], default="bert")
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
@@ -38,14 +41,22 @@ def main():
     from paddle_tpu import nn, optimizer as opt
     from paddle_tpu.models import (
         BertConfig, BertForPretraining, BertPretrainingCriterion,
+        ErnieConfig, ErnieForPretraining, ErniePretrainingCriterion,
     )
 
     paddle.seed(args.seed)
-    cfg = BertConfig(vocab_size=args.vocab, hidden_size=args.hidden,
-                     num_layers=args.layers, num_heads=args.heads,
-                     max_seq_len=args.seq, dropout=0.0)
-    model = BertForPretraining(cfg)
-    criterion = BertPretrainingCriterion()
+    if args.model == "ernie":
+        cfg = ErnieConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                          num_layers=args.layers, num_heads=args.heads,
+                          max_seq_len=args.seq, dropout=0.0)
+        model = ErnieForPretraining(cfg)
+        criterion = ErniePretrainingCriterion()
+    else:
+        cfg = BertConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                         num_layers=args.layers, num_heads=args.heads,
+                         max_seq_len=args.seq, dropout=0.0)
+        model = BertForPretraining(cfg)
+        criterion = BertPretrainingCriterion()
     sched = opt.lr.LinearWarmup(
         opt.lr.PolynomialDecay(learning_rate=args.lr,
                                decay_steps=max(args.steps, 10)),

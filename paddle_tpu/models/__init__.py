@@ -14,6 +14,11 @@ from .gpt import (  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForPretraining, ErniePretrainingCriterion,
+    ErnieForSequenceClassification, ErnieForTokenClassification,
+    ernie_3_0_base, ernie_3_0_medium, ernie_3_0_micro,
+)
 from .generation import build_generate_fn, generate  # noqa: F401
 from .rec import (  # noqa: F401
     RecConfig, DeepFM, WideDeep, FusedSparseEmbedding, synthetic_click_batch,

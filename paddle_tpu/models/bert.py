@@ -85,17 +85,23 @@ class BertModel(nn.Layer):
         self.layers = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
-    def forward(self, ids, token_type_ids=None, attn_mask=None):
+    def _embed(self, ids, token_type_ids=None):
         b, s = ids.shape
         pos = T.arange(0, s, 1, dtype="int64")
         x = self.word_embeddings(ids) + self.position_embeddings(pos)
         if token_type_ids is not None:
             x = x + self.token_type_embeddings(token_type_ids)
+        return x
+
+    def _encode(self, x, attn_mask=None):
         x = self.drop(self.ln(x))
         for l in self.layers:
             x = l(x, attn_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
+
+    def forward(self, ids, token_type_ids=None, attn_mask=None):
+        return self._encode(self._embed(ids, token_type_ids), attn_mask)
 
 
 class BertForPretraining(nn.Layer):
@@ -117,9 +123,7 @@ class BertForPretraining(nn.Layer):
         self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, ids, token_type_ids=None, attn_mask=None,
-                masked_positions=None):
-        seq, pooled = self.bert(ids, token_type_ids, attn_mask)
+    def _heads(self, seq, pooled, masked_positions=None):
         if masked_positions is not None:
             b, s, h = seq.shape
             flat = T.reshape(seq, [b * s, h])
@@ -127,8 +131,12 @@ class BertForPretraining(nn.Layer):
         h_out = self.ln(F.gelu(self.transform(seq)))
         w = self.bert.word_embeddings.weight
         mlm_logits = T.matmul(h_out, w, transpose_y=True)
-        nsp_logits = self.nsp(pooled)
-        return mlm_logits, nsp_logits
+        return mlm_logits, self.nsp(pooled)
+
+    def forward(self, ids, token_type_ids=None, attn_mask=None,
+                masked_positions=None):
+        seq, pooled = self.bert(ids, token_type_ids, attn_mask)
+        return self._heads(seq, pooled, masked_positions)
 
 
 class BertPretrainingCriterion(nn.Layer):

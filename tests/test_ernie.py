@@ -1,0 +1,115 @@
+"""ERNIE model family (BASELINE config 2 names ERNIE-3.0 pretraining)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import (
+    ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification,
+    ErnieForTokenClassification, ErnieModel, ErniePretrainingCriterion,
+    ernie_3_0_base, ernie_3_0_micro,
+)
+
+CFG = ErnieConfig(vocab_size=256, hidden_size=32, num_layers=2, num_heads=2,
+                  max_seq_len=32, dropout=0.0)
+
+
+def test_configs():
+    assert ernie_3_0_base().hidden_size == 768
+    assert ernie_3_0_base().vocab_size == 40000
+    assert ernie_3_0_micro().num_layers == 4
+
+
+def test_task_type_embedding_is_live():
+    """ERNIE's distinguishing input: task ids must change the encoding."""
+    paddle.seed(0)
+    model = ErnieModel(CFG)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 256, (2, 16)).astype("int64"))
+    t0 = paddle.to_tensor(np.zeros((2, 16), "int64"))
+    t1 = paddle.to_tensor(np.ones((2, 16), "int64"))
+    seq0, _ = model(ids, task_type_ids=t0)
+    seq1, _ = model(ids, task_type_ids=t1)
+    assert not np.allclose(np.asarray(seq0.numpy()), np.asarray(seq1.numpy()))
+    # use_task_id=False drops the table entirely
+    cfg2 = ErnieConfig(vocab_size=256, hidden_size=32, num_layers=1,
+                       num_heads=2, max_seq_len=32, use_task_id=False)
+    m2 = ErnieModel(cfg2)
+    assert not hasattr(m2, "task_type_embeddings")
+    m2(ids)  # runs without task ids
+
+
+def test_ernie_pretraining_trains():
+    paddle.seed(0)
+    model = ErnieForPretraining(CFG)
+    crit = ErniePretrainingCriterion()
+    rng = np.random.RandomState(0)
+    b, s, m = 2, 16, 4
+    ids = rng.randint(0, 256, (b, s)).astype("int64")
+    pos = np.stack([rng.choice(s, m, replace=False) + i * s
+                    for i in range(b)]).astype("int64")
+    mlm_labels = ids.reshape(-1)[pos.reshape(-1)].astype("int64")
+    sop_labels = rng.randint(0, 2, (b,)).astype("int64")
+    mlm_logits, sop_logits = model(paddle.to_tensor(ids),
+                                   masked_positions=paddle.to_tensor(pos))
+    assert mlm_logits.shape == [b * m, CFG.vocab_size]
+    assert sop_logits.shape == [b, 2]
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters(),
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    losses = []
+    for _ in range(6):
+        mlm_logits, sop_logits = model(
+            paddle.to_tensor(ids), masked_positions=paddle.to_tensor(pos))
+        loss = crit(mlm_logits, sop_logits, paddle.to_tensor(mlm_labels),
+                    paddle.to_tensor(sop_labels),
+                    masked_lm_scale=float(b * m))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_ernie_finetune_heads():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (4, 16)).astype("int64"))
+    seq_model = ErnieForSequenceClassification(CFG, num_classes=3)
+    out = seq_model(ids)
+    assert out.shape == [4, 3]
+    tok_model = ErnieForTokenClassification(CFG, num_classes=5)
+    out = tok_model(ids)
+    assert out.shape == [4, 16, 5]
+    # fine-tuning decreases loss
+    labels = paddle.to_tensor(rng.randint(0, 3, (4, 1)).astype("int64"))
+    crit = nn.CrossEntropyLoss()
+    o = opt.AdamW(learning_rate=1e-3, parameters=seq_model.parameters())
+    losses = []
+    for _ in range(6):
+        loss = crit(seq_model(ids), labels)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_pad_mask_default():
+    """With no explicit mask, pad positions must not influence non-pad
+    encodings (PaddleNLP ErnieModel default-mask behavior): appending pads
+    leaves the original positions' outputs unchanged."""
+    paddle.seed(0)
+    cfg = ErnieConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                      num_heads=2, max_seq_len=32, dropout=0.0,
+                      pad_token_id=0)
+    model = ErnieModel(cfg)
+    rng = np.random.RandomState(1)
+    core = rng.randint(1, 256, (2, 8)).astype("int64")  # no pad ids inside
+    padded = np.concatenate([core, np.zeros((2, 8), "int64")], axis=1)
+    seq_a, pooled_a = model(paddle.to_tensor(core))
+    seq_b, pooled_b = model(paddle.to_tensor(padded))
+    np.testing.assert_allclose(np.asarray(seq_a.numpy()),
+                               np.asarray(seq_b.numpy())[:, :8], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pooled_a.numpy()),
+                               np.asarray(pooled_b.numpy()), atol=1e-5)
