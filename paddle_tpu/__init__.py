@@ -70,6 +70,7 @@ from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
 from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
+from . import dataset  # noqa: F401  (legacy reader-creator surface)
 from .framework.flags import get_flags, set_flags  # noqa: F401
 
 from .dygraph.tensor import Tensor, to_tensor  # noqa: F401
@@ -83,6 +84,6 @@ from .dygraph.base import (  # noqa: F401
 from .tensor_api import *  # noqa: F401,F403
 from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
 
-from .io_api import load, save  # noqa: F401
+from .io_api import batch, load, save  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .dygraph.parallel import DataParallel  # noqa: F401

@@ -1,0 +1,23 @@
+"""Legacy ``paddle.dataset`` reader-creator surface.
+
+Parity: ``/root/reference/python/paddle/dataset/`` (mnist.py, cifar.py,
+uci_housing.py, imdb.py, imikolov.py, movielens.py, flowers.py, voc2012.py,
+wmt14.py, wmt16.py, conll05.py) — the pre-2.x API where each dataset module
+exposes ``train()``/``test()`` functions returning a *reader creator* (a
+zero-arg callable yielding sample tuples), consumed by
+``paddle.batch``-style loops.
+
+Thin compatibility layer: every reader delegates to the class-based
+datasets in ``paddle_tpu.vision.datasets`` / ``paddle_tpu.text.datasets``
+(which document the no-network-egress data placement convention); dataset
+construction happens lazily inside the reader so importing this package
+never requires the data files.
+"""
+
+from . import (  # noqa: F401
+    cifar, conll05, flowers, imdb, imikolov, mnist, movielens, uci_housing,
+    voc2012, wmt14, wmt16,
+)
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
+           "flowers", "voc2012", "wmt14", "wmt16", "conll05"]

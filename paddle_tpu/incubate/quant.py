@@ -1,8 +1,10 @@
-"""Imperative quantization-aware training (QAT).
+"""Imperative quantization: QAT + post-training (PTQ).
 
 Parity: ``/root/reference/python/paddle/fluid/contrib/slim/quantization/
 imperative/qat.py`` (``ImperativeQuantAware``: wrap Linear/Conv2D with
-fake-quant on weights + activations; straight-through backward).
+fake-quant on weights + activations; straight-through backward) and
+``imperative/ptq.py`` (``ImperativePTQ``: observer-based calibration,
+then frozen scales — no training).
 
 TPU note: v5e serving gains come from bf16/int8 matmuls — QAT here trains
 the model THROUGH int8 rounding (fake quant in fp) so an int8 deployment
@@ -18,7 +20,8 @@ import numpy as np
 
 from .. import nn
 
-__all__ = ["ImperativeQuantAware", "QuantizedLinear", "QuantizedConv2D"]
+__all__ = ["ImperativeQuantAware", "ImperativePTQ", "QuantizedLinear",
+           "QuantizedConv2D"]
 
 
 def _fake_quant(x, kind: str, bits: int, layer, state_name: str,
@@ -137,4 +140,115 @@ class ImperativeQuantAware:
                     sub, self._wbits, self._abits, self._rate)
             else:
                 self.quantize(sub)
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Post-training quantization (PTQ)
+# ---------------------------------------------------------------------------
+
+
+class _AbsMaxObserver:
+    """Running abs-max over calibration batches (ptq_quantizer.py
+    AbsmaxQuantizer role)."""
+
+    def __init__(self):
+        self.scale = 0.0
+
+    def update(self, arr):
+        m = float(np.abs(np.asarray(arr)).max()) if arr.size else 0.0
+        self.scale = max(self.scale, m)
+
+
+class _AvgAbsMaxObserver(_AbsMaxObserver):
+    """Mean of per-batch abs-max (smoother than the global max when
+    calibration data has outliers)."""
+
+    def __init__(self):
+        self.scale = 0.0
+        self._n = 0
+
+    def update(self, arr):
+        m = float(np.abs(np.asarray(arr)).max()) if arr.size else 0.0
+        self._n += 1
+        self.scale += (m - self.scale) / self._n
+
+
+_PTQ_OBSERVERS = {"abs_max": _AbsMaxObserver, "avg_abs_max": _AvgAbsMaxObserver}
+
+
+class _ObservedLayer(nn.Layer):
+    """Pass-through wrapper recording input-activation statistics."""
+
+    def __init__(self, inner, observer_cls):
+        super().__init__()
+        self.inner = inner
+        self.observer = observer_cls()
+
+    def forward(self, *args, **kw):
+        if args:
+            self.observer.update(args[0]._array)
+        return self.inner(*args, **kw)
+
+
+class ImperativePTQ:
+    """Post-training quantization: calibrate with forward passes only, then
+    freeze fake-quant scales — no training involved.
+
+    Parity: ``/root/reference/python/paddle/fluid/contrib/slim/quantization/
+    imperative/ptq.py`` (``ImperativePTQ.quantize`` installs per-layer
+    quantizers that collect activation stats; ``save_quantized_model``
+    converts).  Flow::
+
+        ptq = ImperativePTQ(algo="avg_abs_max")
+        model = ptq.quantize(model)
+        for batch in calib_loader: model(batch)     # calibration
+        model = ptq.convert(model)                  # frozen fake-quant
+
+    After ``convert`` each Linear/Conv2D runs with the calibrated
+    activation scale (moving-average kernel in is_test mode) and
+    channel-wise weight fake-quant — the same inference math QAT produces,
+    minus the fine-tuning.
+    """
+
+    def __init__(self, quantizable_layer_type: List[str] = ("Linear",
+                                                            "Conv2D"),
+                 algo: str = "avg_abs_max", weight_bits: int = 8,
+                 activation_bits: int = 8):
+        if algo not in _PTQ_OBSERVERS:
+            raise ValueError(
+                f"algo must be one of {sorted(_PTQ_OBSERVERS)}, got {algo!r}")
+        self._types = tuple(quantizable_layer_type)
+        self._observer = _PTQ_OBSERVERS[algo]
+        self._wbits = weight_bits
+        self._abits = activation_bits
+
+    def quantize(self, model: nn.Layer) -> nn.Layer:
+        for name, sub in list(model._sub_layers.items()):
+            cls = type(sub).__name__
+            if cls in self._types and cls in _WRAPPERS:
+                model._sub_layers[name] = _ObservedLayer(sub, self._observer)
+            else:
+                self.quantize(sub)
+        return model
+
+    def convert(self, model: nn.Layer) -> nn.Layer:
+        """Swap observers for fake-quant wrappers seeded with the calibrated
+        scales; the returned model is inference-ready (call ``.eval()``)."""
+        from ..dygraph.tensor import Tensor
+
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, _ObservedLayer):
+                wrapped = _WRAPPERS[type(sub.inner).__name__](
+                    sub.inner, self._wbits, self._abits)
+                scale = sub.observer.scale or 1.0
+                wrapped.register_buffer("_in_scale", Tensor(
+                    np.asarray([scale], "float32"), stop_gradient=True))
+                # frozen calibration: eval mode keeps the moving-average
+                # kernel in is_test so a forward pass can never drift the
+                # calibrated scale (reference PTQ emits frozen scales)
+                wrapped.eval()
+                model._sub_layers[name] = wrapped
+            else:
+                self.convert(sub)
         return model

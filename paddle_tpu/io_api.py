@@ -67,3 +67,24 @@ def load(path: str, return_numpy: bool = False, **configs) -> Any:
     with open(path, "rb") as f:
         data = pickle.load(f)
     return _from_saved(data, return_numpy)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy ``paddle.batch`` (reference ``python/paddle/batch.py``):
+    wrap a sample reader-creator into a batch reader-creator, yielding
+    lists of ``batch_size`` samples (pairs with ``paddle.dataset.*``)."""
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+    return batch_reader

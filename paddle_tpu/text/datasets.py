@@ -41,13 +41,16 @@ class Imdb(Dataset):
     (pos) / 1 (neg)."""
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
-                 download=True):
+                 download=True, word_idx=None):
         assert mode.lower() in ("train", "test"), mode
         self.mode = mode.lower()
         self.data_file = _require(
             data_file, "aclImdb_v1.tar.gz",
             "https://ai.stanford.edu/~amaas/data/sentiment/")
-        self.word_idx = self._build_word_dict(cutoff)
+        # a caller-supplied dict (legacy imdb.train(word_idx) contract) must
+        # govern the id mapping, not a freshly rebuilt one
+        self.word_idx = (dict(word_idx) if word_idx is not None
+                         else self._build_word_dict(cutoff))
         self._load(self.mode)
 
     def _docs(self, pattern):
@@ -91,7 +94,8 @@ class Imikolov(Dataset):
     NGRAM windows or SEQ id sequences over a min-frequency dict."""
 
     def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
-                 mode="train", min_word_freq=50, download=True):
+                 mode="train", min_word_freq=50, download=True,
+                 word_idx=None):
         assert data_type.upper() in ("NGRAM", "SEQ"), data_type
         assert mode.lower() in ("train", "test"), mode
         self.data_type = data_type.upper()
@@ -101,7 +105,10 @@ class Imikolov(Dataset):
         self.data_file = _require(
             data_file, "simple-examples.tgz",
             "http://www.fit.vutbr.cz/~imikolov/rnnlm/")
-        self.word_idx = self._build_word_dict()
+        # legacy imikolov.train(word_idx, n) contract: a supplied dict
+        # governs the id mapping
+        self.word_idx = (dict(word_idx) if word_idx is not None
+                         else self._build_word_dict())
         self._load()
 
     def _lines(self, which):

@@ -1,0 +1,74 @@
+"""Legacy ``paddle.dataset`` reader-creator surface + ``paddle.batch``
+(reference ``python/paddle/dataset/``, ``python/paddle/batch.py``)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_surface_importable():
+    import paddle_tpu.dataset as d
+
+    for mod in ("mnist", "cifar", "uci_housing", "imdb", "imikolov",
+                "movielens", "flowers", "voc2012", "wmt14", "wmt16",
+                "conll05"):
+        assert hasattr(d, mod), mod
+    # readers are lazy: creating one must not require the data files
+    r = d.mnist.train()
+    assert callable(r)
+    with pytest.raises((RuntimeError, FileNotFoundError)):
+        next(iter(d.uci_housing.train()()))
+
+
+def _write_mnist(tmp_path, n=8):
+    imgs = np.random.RandomState(0).randint(0, 256, (n, 28, 28), "uint8")
+    labels = (np.arange(n) % 10).astype("uint8")
+    ip = tmp_path / "imgs.gz"
+    lp = tmp_path / "labels.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return str(ip), str(lp), imgs, labels
+
+
+def test_mnist_reader_and_batch(tmp_path):
+    from paddle_tpu.dataset import mnist
+
+    ip, lp, imgs, labels = _write_mnist(tmp_path)
+    reader = mnist.train(image_path=ip, label_path=lp)
+    samples = list(reader())
+    assert len(samples) == 8
+    flat, label = samples[3]
+    assert flat.shape == (784,) and flat.dtype == np.float32
+    np.testing.assert_allclose(
+        flat, imgs[3].reshape(-1).astype("float32") / 127.5 - 1.0)
+    assert label == int(labels[3])
+
+    # paddle.batch wraps a sample reader into a batch reader
+    batches = list(paddle.batch(reader, batch_size=3)())
+    assert [len(b) for b in batches] == [3, 3, 2]
+    batches = list(paddle.batch(reader, batch_size=3, drop_last=True)())
+    assert [len(b) for b in batches] == [3, 3]
+    with pytest.raises(ValueError):
+        paddle.batch(reader, 0)
+
+
+def test_uci_housing_reader(tmp_path):
+    from paddle_tpu.dataset import uci_housing
+
+    rows = np.random.RandomState(0).rand(10, 14).astype("float64")
+    data_file = tmp_path / "housing.data"
+    np.savetxt(data_file, rows.reshape(-1, 7))
+    train = list(uci_housing.train(data_file=str(data_file))())
+    test = list(uci_housing.test(data_file=str(data_file))())
+    assert len(train) == 8 and len(test) == 2  # 80/20 split
+    feat, price = train[0]
+    assert feat.shape == (13,) and price.shape == (1,)
+    assert feat.dtype == np.float32

@@ -75,3 +75,56 @@ def test_quantized_conv2d():
     assert out.shape == [2, 4, 8, 8]
     out.mean().backward()
     assert x.grad is not None
+
+
+def test_ptq_calibrate_and_convert():
+    """PTQ: observe-only calibration, then frozen fake-quant inference
+    (ptq.py ImperativePTQ role)."""
+    from paddle_tpu.incubate.quant import (
+        ImperativePTQ, QuantizedLinear, _ObservedLayer)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    rs = np.random.RandomState(0)
+    calib = [rs.randn(4, 8).astype("float32") * 3.0 for _ in range(5)]
+    ref_out = np.asarray(net(paddle.to_tensor(calib[0])).numpy())
+
+    ptq = ImperativePTQ(algo="abs_max")
+    net = ptq.quantize(net)
+    assert isinstance(net[0], _ObservedLayer)
+    for batch in calib:
+        net(paddle.to_tensor(batch))
+    # observer saw the global abs max of the first layer's input
+    expected = max(float(np.abs(b).max()) for b in calib)
+    np.testing.assert_allclose(net[0].observer.scale, expected, rtol=1e-6)
+
+    net = ptq.convert(net)
+    net.eval()
+    assert isinstance(net[0], QuantizedLinear)
+    np.testing.assert_allclose(
+        float(np.asarray(net[0]._in_scale.numpy())[0]), expected, rtol=1e-6)
+    out = np.asarray(net(paddle.to_tensor(calib[0])).numpy())
+    # int8 fake-quant stays close to the fp reference
+    assert out.shape == ref_out.shape
+    err = np.abs(out - ref_out).max() / (np.abs(ref_out).max() + 1e-6)
+    assert err < 0.1, err
+    # calibrated scale is frozen in eval mode (is_test): a huge input must
+    # not move it
+    net(paddle.to_tensor(100.0 * calib[0]))
+    np.testing.assert_allclose(
+        float(np.asarray(net[0]._in_scale.numpy())[0]), expected, rtol=1e-6)
+
+
+def test_ptq_avg_algo_and_bad_algo():
+    from paddle_tpu.incubate.quant import ImperativePTQ
+
+    with pytest.raises(ValueError):
+        ImperativePTQ(algo="kl_not_implemented")
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    ptq = ImperativePTQ(algo="avg_abs_max")
+    wrapper = ptq.quantize(nn.Sequential(net))
+    vals = [np.full((2, 4), v, "float32") for v in (1.0, 2.0, 3.0)]
+    for v in vals:
+        wrapper(paddle.to_tensor(v))
+    np.testing.assert_allclose(wrapper[0].observer.scale, 2.0, rtol=1e-6)
