@@ -1,30 +1,68 @@
-"""``paddle.onnx`` — export surface.
+"""``paddle.onnx`` — native ONNX export.
 
-Parity: ``/root/reference/python/paddle/onnx/export.py`` (which delegates
-to the external ``paddle2onnx`` package).  The ``onnx`` python package is
-not in this build's baked environment; when it IS present, a basic
-Program->ONNX conversion could be layered over the saved inference model
-(static/io.py), so ``export`` probes for it and raises with actionable
-guidance otherwise — matching the reference's hard dependency error.
+Parity: ``/root/reference/python/paddle/onnx/export.py`` delegates to the
+external ``paddle2onnx`` package; this build converts natively instead —
+the layer is traced to a Program (``jit.to_static`` re-trace), ops are
+mapped to ONNX nodes (``convert.py``), and the ModelProto is hand-encoded
+in protobuf wire format (``proto.py``), so export works with no ``onnx``
+dependency in the environment.
+
+A numpy reference interpreter for the emitted op set lives in
+``runner.py`` — tests run the exported graph and assert numeric parity
+with the source model's forward.
 """
 
 from __future__ import annotations
 
+from typing import Optional, Sequence
+
+import numpy as np
+
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Parity: paddle.onnx.export — requires the ``onnx`` package."""
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 17, **configs):
+    """Export ``layer`` to ``{path}.onnx``.
+
+    ``input_spec``: list of ``paddle.static.InputSpec`` (or Tensors) fixing
+    input shapes/dtypes, like the reference API.  Returns the written path.
+    """
+    from .. import jit
+    from ..dygraph.tensor import Tensor
+    from .convert import convert_program
+
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export requires input_spec (shapes of the inputs)")
+    specs = []
+    concrete = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            s = jit.InputSpec(list(s.shape), s.dtype,
+                              getattr(s, "name", None))
+        specs.append(s)
+        shape = [1 if (d is None or int(d) < 0) else int(d)
+                 for d in s.shape]
+        concrete.append(Tensor(np.zeros(shape, s.dtype or "float32")))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()  # inference graph: dropout=identity, BN uses stats
     try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "paddle.onnx.export requires the 'onnx' package (the reference "
-            "delegates to paddle2onnx the same way); it is not part of this "
-            "build's baked environment. For deployment use "
-            "paddle.inference.Predictor over save_inference_model, or "
-            "jax.export for StableHLO serialization."
-        ) from e
-    raise NotImplementedError(
-        "ONNX graph conversion is not implemented; use "
-        "paddle.inference.Predictor (XLA) or jax.export (StableHLO)")
+        fn = layer.forward if hasattr(layer, "forward") else layer
+        sf = jit.to_static(fn, input_spec=specs)
+        main, startup, feed_names, fetch_names, _ = sf.get_traced(
+            tuple(concrete))
+        model_bytes = convert_program(
+            main, sf._scope, feed_names, fetch_names,
+            opset_version=opset_version,
+            graph_name=type(layer).__name__)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model_bytes)
+    return out_path

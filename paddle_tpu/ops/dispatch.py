@@ -74,7 +74,9 @@ def dispatch_static(
         type=op_type,
         inputs={s: [v.name for v in vs] for s, vs in norm_in.items()},
         outputs={
-            s: [v.name if isinstance(v, fw.Variable) else v for v in vs]
+            # accept Variables, eager Tensors bound into the program by name
+            # (jit re-trace binds layer buffers this way), or raw names
+            s: [getattr(v, "name", v) for v in vs]
             for s, vs in outputs.items()
         },
         attrs=attrs,
@@ -82,7 +84,8 @@ def dispatch_static(
     result: Dict[str, List[fw.Variable]] = {}
     for slot, vs in outputs.items():
         result[slot] = [
-            v if isinstance(v, fw.Variable) else block._var_recursive(v) for v in vs
+            v if isinstance(v, fw.Variable)
+            else block._var_recursive(getattr(v, "name", v)) for v in vs
         ]
     return result
 

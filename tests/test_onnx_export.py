@@ -1,0 +1,167 @@
+"""Native ONNX export: Program -> hand-encoded ModelProto, verified by
+decoding the wire format and running the graph with the numpy reference
+interpreter (paddle_tpu/onnx/{proto,convert,runner}.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+from paddle_tpu.onnx import export, proto, runner
+
+
+def test_proto_roundtrip():
+    """The wire-format writer and reader must agree."""
+    t = proto.tensor("w", (2, 3), proto.DTYPE["float32"],
+                     np.arange(6, dtype="float32").tobytes())
+    msg = proto.parse_message(t)
+    assert [int(v) for v in msg[1]] == [2, 3]
+    assert int(msg[2][0]) == 1
+    assert msg[8][0] == b"w"
+    np.testing.assert_array_equal(
+        np.frombuffer(msg[9][0], "float32"), np.arange(6, dtype="float32"))
+    # negative varints (e.g. axis=-1) encode as 10-byte two's complement
+    a = proto.attribute("axis", -1)
+    am = proto.parse_message(a)
+    assert int(am[3][0]) - (1 << 64) == -1
+
+
+def _roundtrip(model, spec, x, rtol=1e-4, atol=1e-5):
+    model.eval()
+    ref = np.asarray(model(paddle.to_tensor(x)).numpy())
+    path = export(model, "/tmp/onnx_export_test", input_spec=spec)
+    g = runner.load(path)
+    (out,) = runner.run(g, {g.input_names[0]: x})
+    np.testing.assert_allclose(out, ref, rtol=rtol, atol=atol)
+    return g
+
+
+def test_mlp_export_parity():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.Softmax())
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    g = _roundtrip(model, [jit.InputSpec([3, 8], "float32", "x")], x)
+    ops = [n["op"] for n in g.nodes]
+    assert "MatMul" in ops and "Relu" in ops and "Softmax" in ops
+    # params became initializers
+    assert any(v.shape == (8, 16) for v in g.inits.values())
+
+
+def test_conv_bn_pool_export_parity():
+    paddle.seed(0)
+    model = nn.Sequential(
+        nn.Conv2D(2, 4, 3, padding=1), nn.BatchNorm2D(4), nn.ReLU(),
+        nn.MaxPool2D(2, 2), nn.Flatten(), nn.Linear(4 * 4 * 4, 5))
+    x = np.random.RandomState(1).randn(2, 2, 8, 8).astype("float32")
+    g = _roundtrip(model, [jit.InputSpec([2, 2, 8, 8], "float32", "im")], x)
+    ops = [n["op"] for n in g.nodes]
+    assert "Conv" in ops and "BatchNormalization" in ops and "MaxPool" in ops
+
+
+def test_gelu_layernorm_export_parity():
+    paddle.seed(0)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln = nn.LayerNorm(16)
+            self.fc = nn.Linear(16, 16)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+
+            return F.gelu(self.fc(self.ln(x)))
+
+    x = np.random.RandomState(2).randn(2, 4, 16).astype("float32")
+    g = _roundtrip(Block(), [jit.InputSpec([2, 4, 16], "float32", "x")], x)
+    ops = [n["op"] for n in g.nodes]
+    assert "LayerNormalization" in ops and "Erf" in ops
+
+
+def test_lenet_export_parity():
+    """Model-zoo LeNet exports and matches numerically."""
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    x = np.random.RandomState(3).randn(1, 1, 28, 28).astype("float32")
+    _roundtrip(model, [jit.InputSpec([1, 1, 28, 28], "float32", "im")], x,
+               rtol=1e-3, atol=1e-4)
+
+
+def test_unmapped_op_raises():
+    class Odd(nn.Layer):
+        def forward(self, x):
+            import paddle_tpu.tensor_api as T
+
+            return T.cumsum(x, axis=1)
+
+    with pytest.raises(NotImplementedError, match="cumsum"):
+        export(Odd(), "/tmp/onnx_unmapped",
+               input_spec=[jit.InputSpec([2, 3], "float32", "x")])
+
+
+def test_flatten_variants_export_parity():
+    import paddle_tpu.tensor_api as T
+
+    class F0(nn.Layer):
+        def forward(self, x):
+            return T.flatten(x)  # start_axis=0: rank-1 output
+
+    class F2(nn.Layer):
+        def forward(self, x):
+            return T.flatten(x, start_axis=2)
+
+    x = np.random.RandomState(4).randn(2, 3, 4, 5).astype("float32")
+    _roundtrip(F0(), [jit.InputSpec([2, 3, 4, 5], "float32", "x")], x)
+    _roundtrip(F2(), [jit.InputSpec([2, 3, 4, 5], "float32", "x")], x)
+
+
+def test_scale_bias_order_export_parity():
+    import paddle_tpu.tensor_api as T
+
+    class SAfter(nn.Layer):
+        def forward(self, x):
+            return T.scale(x, scale=2.0, bias=1.0, bias_after_scale=True)
+
+    class SBefore(nn.Layer):
+        def forward(self, x):
+            return T.scale(x, scale=2.0, bias=1.0, bias_after_scale=False)
+
+    x = np.ones((2, 3), "float32")
+    _roundtrip(SAfter(), [jit.InputSpec([2, 3], "float32", "x")], x)
+    _roundtrip(SBefore(), [jit.InputSpec([2, 3], "float32", "x")], x)
+
+
+def test_padded_avgpool_export_parity():
+    paddle.seed(0)
+    model = nn.Sequential(nn.AvgPool2D(2, stride=2, padding=1))
+    x = np.ones((1, 1, 4, 4), "float32")
+    g = _roundtrip(model, [jit.InputSpec([1, 1, 4, 4], "float32", "x")], x)
+    assert g.nodes[0]["op"] == "AveragePool"
+
+
+def test_approximate_gelu_export_parity():
+    class G(nn.Layer):
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+
+            return F.gelu(x, approximate=True)
+
+    x = np.random.RandomState(5).randn(2, 8).astype("float32") * 2
+    g = _roundtrip(G(), [jit.InputSpec([2, 8], "float32", "x")], x)
+    assert any(n["op"] == "Tanh" for n in g.nodes)  # tanh approximation
+
+
+def test_opset_validation():
+    model = nn.Sequential(nn.LayerNorm(8))
+    with pytest.raises(ValueError, match="opset"):
+        export(model, "/tmp/onnx_opset",
+               input_spec=[jit.InputSpec([2, 8], "float32", "x")],
+               opset_version=13)
+    with pytest.raises(ValueError, match="opset"):
+        export(nn.Linear(4, 4), "/tmp/onnx_opset9",
+               input_spec=[jit.InputSpec([2, 4], "float32", "x")],
+               opset_version=9)
