@@ -1,0 +1,106 @@
+"""BASELINE config 3: GPT pretraining with hybrid parallelism, end to end.
+
+One jitted train step (fwd+bwd+AdamW) over the 4-axis hybrid mesh:
+dp x mp(tensor) x pp(weight-sharded scan) x sharding(ZeRO).  On one chip
+all degrees default to 1 and this is the single-device flagship path
+bench.py measures; on a virtual CPU mesh it exercises the full hybrid
+sharding (how the driver's dryrun runs it).
+
+    python examples/train_gpt.py --steps 10 --config tiny
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/train_gpt.py --dp 2 --mp 2 --pp 2 --config tiny
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny",
+                   choices=["tiny", "small", "medium", "1p3b", "13b"])
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=0, help="0 = config default")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--mp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--sharding", type=int, default=1)
+    p.add_argument("--sharding-stage", type=int, default=None)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--remat", default="0", choices=["0", "1", "dots"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+
+    # the image's sitecustomize imports jax before env vars can take effect;
+    # honor JAX_PLATFORMS=cpu through the live config (same workaround as
+    # tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.models import GPTForPretraining
+    from paddle_tpu.models import gpt as gpt_mod
+
+    need = args.dp * args.mp * args.pp * args.sharding
+    if need > 1:
+        mesh_mod.build_hybrid_mesh(dp=args.dp, mp=args.mp, pp=args.pp,
+                                   sharding=args.sharding)
+        print(f"mesh: dp={args.dp} mp={args.mp} pp={args.pp} "
+              f"sharding={args.sharding} over {need} of "
+              f"{len(jax.devices())} devices")
+
+    cfg_fn = {"tiny": gpt_mod.gpt_tiny, "small": gpt_mod.gpt_small,
+              "medium": gpt_mod.gpt_medium, "1p3b": gpt_mod.gpt_1p3b,
+              "13b": gpt_mod.gpt_13b}[args.config]
+    cfg = cfg_fn(use_parallel=args.mp > 1)
+    seq = args.seq or min(cfg.max_seq_len, 512)
+
+    paddle.seed(args.seed)
+    model = GPTForPretraining(cfg)
+    n_params = sum(int(np.prod(q.shape)) for q in model.parameters())
+    print(f"GPT-{args.config}: {n_params/1e6:.1f}M params, seq {seq}, "
+          f"batch {args.batch}")
+
+    remat = {"0": False, "1": True, "dots": "dots"}[args.remat]
+    step, params, opt_state = gpt_mod.build_functional_train_step(
+        model, lr=args.lr, remat=remat,
+        sharding_stage=args.sharding_stage,
+        ce_chunk_rows=2048 if cfg.vocab_size > 10000 else 0)
+
+    rng = np.random.RandomState(args.seed)
+    ids = rng.randint(0, cfg.vocab_size, (args.batch, seq)).astype("int32")
+    labels = rng.randint(0, cfg.vocab_size,
+                         (args.batch, seq)).astype("int64")
+    if need > 1:
+        ids = mesh_mod.shard_batch(ids)
+        labels = mesh_mod.shard_batch(labels)
+
+    losses = []
+    t0 = time.time()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+        losses.append(float(np.asarray(loss)))
+        if i == 0:
+            t0 = time.time()  # exclude compile
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {losses[-1]:.4f}", flush=True)
+    steps_timed = max(args.steps - 1, 1)
+    tok_s = args.batch * seq * steps_timed / max(time.time() - t0, 1e-9)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    print(f"OK: loss {losses[0]:.4f} -> {losses[-1]:.4f}, "
+          f"{tok_s:,.0f} tokens/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import asp  # noqa: F401
+from . import auto_checkpoint  # noqa: F401
 from . import quant  # noqa: F401
 
 __all__ = [

@@ -19,7 +19,9 @@ from .ernie import (  # noqa: F401
     ErnieForSequenceClassification, ErnieForTokenClassification,
     ernie_3_0_base, ernie_3_0_medium, ernie_3_0_micro,
 )
-from .generation import build_generate_fn, generate  # noqa: F401
+from .generation import (  # noqa: F401
+    build_beam_search_fn, build_generate_fn, generate,
+)
 from .rec import (  # noqa: F401
     RecConfig, DeepFM, WideDeep, FusedSparseEmbedding, synthetic_click_batch,
 )

@@ -176,3 +176,136 @@ def generate(model, ids, max_new_tokens: int = 32, temperature: float = 1.0,
     fn = build_generate_fn(model, max_new_tokens, temperature, top_k, greedy)
     out = fn(arr, seed)
     return Tensor(out, stop_gradient=True) if isinstance(ids, Tensor) else out
+
+
+def build_beam_search_fn(model, max_new_tokens: int, beam_size: int = 4,
+                         length_penalty: float = 0.0,
+                         eos_token_id: Optional[int] = None):
+    """Compile beam-search decoding: ``ids (B, T0) -> (B, T0 + new)``.
+
+    Role parity: the reference's ``beam_search``/``beam_search_decode`` op
+    pair (``operators/math/beam_search.cu``) and PaddleNLP's
+    ``decode_strategy="beam_search"``.  TPU-first shape discipline: beams
+    are flattened into the batch dim (B*K rows), every step is ONE
+    (B*K)-row forward against the shared KV cache, and the whole search is
+    a single ``lax.scan`` — no dynamic shapes, no host round-trips; beam
+    reordering is a ``take`` over the cache's row axis.
+
+    Scores are sum of token log-probs; ``length_penalty`` applies the GNMT
+    ``((5+len)/6)**alpha`` normalization at final selection.  When
+    ``eos_token_id`` is set, finished beams are frozen (only the EOS
+    continuation keeps the score; the emitted token stays EOS).
+    """
+    cfg = model.cfg
+    if cfg.use_parallel:
+        raise NotImplementedError("beam search is wired for the non-TP model")
+    gpt = model.gpt
+    eps = cfg.layer_norm_eps
+    n_heads = cfg.num_heads
+    L = cfg.num_layers
+    K = beam_size
+    params = {
+        "wte": gpt.embeddings.word_embeddings.weight._array,
+        "wpe": gpt.embeddings.position_embeddings.weight._array,
+        "lnf_g": gpt.ln_f.weight._array, "lnf_b": gpt.ln_f.bias._array,
+        "blocks": [_block_params(b) for b in gpt.blocks],
+    }
+
+    def logits_from(x, p):
+        x = _ln(x, p["lnf_g"], p["lnf_b"], eps)
+        return (x @ p["wte"].T).astype(jnp.float32)
+
+    @jax.jit
+    def gen(p, ids):
+        b, t0 = ids.shape
+        s_max = t0 + max_new_tokens
+        hd = cfg.hidden_size // n_heads
+        dt = p["wte"].dtype
+        V = p["wte"].shape[0]
+
+        def run(tokens, pos, kc, vc):
+            t = tokens.shape[1]
+            x = p["wte"][tokens] + p["wpe"][pos + jnp.arange(t)]
+            new_k, new_v = [], []
+            for li, bp in enumerate(p["blocks"]):
+                x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
+                                       n_heads, eps)
+                new_k.append(k1)
+                new_v.append(v1)
+            return logits_from(x, p), jnp.stack(new_k), jnp.stack(new_v)
+
+        # prefill on the B prompts, then expand to B*K beams
+        kc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
+        vc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
+        logits, kc, vc = run(ids, 0, kc, vc)
+        lp = jax.nn.log_softmax(logits[:, -1])            # (B, V)
+        scores0, tok0 = lax.top_k(lp, K)                   # (B, K)
+        kc = jnp.repeat(kc, K, axis=1)                     # rows: b*K + k
+        vc = jnp.repeat(vc, K, axis=1)
+        tokens = tok0.reshape(b * K)
+        scores = scores0.reshape(b * K)
+        finished = (jnp.zeros((b * K,), bool) if eos_token_id is None
+                    else tokens == eos_token_id)
+        lengths = jnp.ones((b * K,), jnp.float32)  # generated tokens so far
+
+        def step(carry, i):
+            tokens, scores, finished, lengths, kc, vc = carry
+            logits, kc2, vc2 = run(tokens[:, None], t0 + i, kc, vc)
+            lp = jax.nn.log_softmax(logits[:, -1])         # (B*K, V)
+            if eos_token_id is not None:
+                # frozen beams: only the EOS continuation survives, at an
+                # unchanged score
+                frozen = jnp.full((V,), -jnp.inf).at[eos_token_id].set(0.0)
+                lp = jnp.where(finished[:, None], frozen[None, :], lp)
+            cand = scores[:, None] + lp                    # (B*K, V)
+            cand = cand.reshape(b, K * V)
+            new_scores, flat = lax.top_k(cand, K)          # (B, K)
+            parent = flat // V                             # beam idx in 0..K
+            new_tok = flat % V
+            rows = (jnp.arange(b)[:, None] * K + parent).reshape(b * K)
+            kc2 = jnp.take(kc2, rows, axis=1)
+            vc2 = jnp.take(vc2, rows, axis=1)
+            tokens = new_tok.reshape(b * K)
+            scores = new_scores.reshape(b * K)
+            finished = jnp.take(finished, rows)
+            # beams still live grew by one token; frozen beams keep the
+            # length they had when they hit EOS (feeds length_penalty)
+            lengths = jnp.take(lengths, rows) + (~finished).astype(
+                jnp.float32)
+            if eos_token_id is not None:
+                finished = finished | (tokens == eos_token_id)
+            return ((tokens, scores, finished, lengths, kc2, vc2),
+                    (tokens, rows))
+
+        carry = (tokens, scores, finished, lengths, kc, vc)
+        (tokens, scores, finished, lengths, _, _), (toks, parents) = lax.scan(
+            step, carry, jnp.arange(max_new_tokens - 1))
+
+        # backtrack through the parent pointers to materialize sequences
+        def back(carry, sp):
+            rows = carry                                  # (B*K,) row ids
+            step_toks, step_parents = sp
+            tok = jnp.take(step_toks, rows)
+            rows = jnp.take(step_parents, rows)
+            return rows, tok
+
+        last_rows = jnp.arange(b * K)
+        rows, rev = lax.scan(back, last_rows,
+                             (toks[::-1], parents[::-1]))
+        seq = rev[::-1]                                    # (new-1, B*K)
+        first = jnp.take(tok0.reshape(b * K), rows)        # step-0 tokens
+        beams = jnp.concatenate([first[None], seq], axis=0)  # (new, B*K)
+
+        # length-penalized selection of the best beam per batch row, using
+        # each beam's ACTUAL generated length (frozen at its EOS)
+        norm = (jnp.power((5.0 + lengths) / 6.0, length_penalty)
+                if length_penalty else jnp.ones_like(lengths))
+        best = jnp.argmax((scores / norm).reshape(b, K), axis=1)  # (B,)
+        pick = jnp.arange(b) * K + best
+        out = jnp.take(beams, pick, axis=1).T              # (B, new)
+        return jnp.concatenate([ids, out.astype(ids.dtype)], axis=1)
+
+    def call(ids):
+        return gen(params, jnp.asarray(ids))
+
+    return call

@@ -126,6 +126,25 @@ def _cv_pool2d(ctx, op):
                 else "GlobalMaxPool")
         ctx.emit(kind, [x], [out])
         return
+    if a.get("adaptive"):
+        # adaptive pooling derives kernel/stride from the in/out sizes; it
+        # only maps onto a plain ONNX pool when the input divides evenly
+        shape = ctx.shape(x)
+        osize = [int(v) for v in a.get("ksize")]
+        hw = ([int(d) for d in shape[2:4]]
+              if shape and len(shape) >= 4
+              and all(d is not None and int(d) > 0 for d in shape[2:4])
+              else None)
+        if hw is None or any(i % o for i, o in zip(hw, osize)):
+            raise NotImplementedError(
+                f"adaptive pool2d with output {osize} on input {shape}: "
+                "not expressible as a fixed-kernel ONNX pool")
+        kern = [i // o for i, o in zip(hw, osize)]
+        kind = ("AveragePool" if a.get("pooling_type") == "avg"
+                else "MaxPool")
+        ctx.emit(kind, [x], [out], kernel_shape=kern, strides=kern,
+                 pads=[0, 0, 0, 0])
+        return
     pads = list(a.get("paddings", [0, 0]))
     if len(pads) == 2:
         pads = [pads[0], pads[1], pads[0], pads[1]]

@@ -183,7 +183,10 @@ def run(graph: Graph, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
             ax = a.get("axis", 1)
             r = x[0].reshape(int(np.prod(x[0].shape[:ax]) or 1), -1)
         elif op == "Reshape":
-            shape = [int(v) for v in x[1]]
+            # ONNX (allowzero=0): a 0 entry copies the input dim at the
+            # same index — numpy would read it as an empty dimension
+            shape = [int(x[0].shape[i]) if int(v) == 0 else int(v)
+                     for i, v in enumerate(x[1])]
             r = x[0].reshape(shape)
         elif op == "Transpose":
             r = np.transpose(x[0], a["perm"])

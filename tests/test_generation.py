@@ -57,3 +57,72 @@ def test_sampling_modes_and_single_token():
     assert s1.shape == (1, 10)
     assert (s1[:, :4] == ids).all()
     assert (s1 < 64).all() and (s1 >= 0).all()
+
+
+def test_beam_search_beam1_matches_greedy():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    from paddle_tpu.models import build_beam_search_fn, build_generate_fn
+
+    ids = np.random.RandomState(0).randint(0, 97, (2, 5)).astype("int32")
+    greedy = build_generate_fn(model, max_new_tokens=6, greedy=True)(ids)
+    beam1 = build_beam_search_fn(model, max_new_tokens=6, beam_size=1)(ids)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(beam1))
+
+
+def test_beam_search_score_not_worse_than_greedy():
+    paddle.seed(1)
+    cfg = GPTConfig(vocab_size=53, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    from paddle_tpu.models import build_beam_search_fn, build_generate_fn
+
+    ids = np.random.RandomState(1).randint(0, 53, (1, 4)).astype("int32")
+    n = 5
+
+    def seq_logprob(full):
+        import jax.numpy as jnp
+
+        import paddle_tpu as pd
+
+        logits = model(pd.to_tensor(np.asarray(full)))._array
+        lp = np.asarray(jax.nn.log_softmax(logits.astype("float32"), axis=-1))
+        tot = 0.0
+        for t in range(ids.shape[1] - 1, full.shape[1] - 1):
+            tot += lp[0, t, int(full[0, t + 1])]
+        return tot
+
+    import jax
+
+    greedy = np.asarray(build_generate_fn(model, n, greedy=True)(ids))
+    beam = np.asarray(build_beam_search_fn(model, n, beam_size=4)(ids))
+    assert beam.shape == greedy.shape == (1, ids.shape[1] + n)
+    assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+def test_beam_search_eos_freezes():
+    paddle.seed(2)
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1, num_heads=2,
+                    max_seq_len=64, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    from paddle_tpu.models import build_beam_search_fn
+
+    ids = np.random.RandomState(2).randint(0, 31, (2, 3)).astype("int32")
+    # pick the greedy first token as EOS so beams finish immediately
+    import paddle_tpu as pd
+
+    logits = model(pd.to_tensor(ids))._array
+    eos = int(np.asarray(logits)[0, -1].argmax())
+    out = np.asarray(build_beam_search_fn(
+        model, max_new_tokens=5, beam_size=3, eos_token_id=eos)(ids))
+    row = out[0, ids.shape[1]:]
+    # the eos-first beam has the max step-0 score and, frozen, never loses
+    # it (other beams only ADD negative log-probs) — it must win, and its
+    # continuation must stay frozen at EOS
+    assert row[0] == eos, (row, eos)
+    assert (row == eos).all(), row
