@@ -71,6 +71,7 @@ from . import incubate  # noqa: F401
 from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from . import dataset  # noqa: F401  (legacy reader-creator surface)
+from . import linalg  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 
 from .dygraph.tensor import Tensor, to_tensor  # noqa: F401

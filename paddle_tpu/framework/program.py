@@ -173,6 +173,20 @@ class Parameter(Variable):
         self.is_distributed = is_distributed
 
 
+def raise_with_op_site(op, what: str, e: Exception):
+    """Re-raise an op failure annotated with the op type and (when
+    FLAGS_call_stack_level >= 2) its Python creation stack — the single
+    error-provenance formatter (reference op_call_stack.cc role) shared by
+    shape inference and the executor's lowering loop."""
+    site = getattr(op, "callstack", None)
+    raise RuntimeError(
+        f"op {op.type!r} {what}: {e}"
+        + (f"\n[operator creation stack]\n{site}" if site else
+           "\n(set FLAGS_call_stack_level=2 for the operator creation "
+           "stack)")
+    ) from e
+
+
 class Operator:
     """Parity: ``framework.py:1921`` Operator / OpDesc (framework.proto:43).
 
@@ -330,6 +344,8 @@ class Block:
             registry.infer_shape(self, op)
         except registry.OpNotRegistered:
             pass
+        except Exception as e:
+            raise_with_op_site(op, "failed shape inference", e)
 
     def to_dict(self) -> dict:
         return {

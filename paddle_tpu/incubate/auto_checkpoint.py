@@ -113,9 +113,18 @@ class TrainEpochRange:
         scope = global_scope()
         for name, arr in state.get("scope", {}).items():
             scope.set(name, arr)
-        for i, sd in enumerate(state.get("objects", [])):
-            if i < len(_registered):
-                _registered[i].set_state_dict(sd)
+        objects = state.get("objects", [])
+        if len(objects) != len(_registered):
+            # positional restore requires the relaunch to have registered
+            # the same objects in the same order; a silent partial restore
+            # would load state into the wrong object
+            raise RuntimeError(
+                f"auto_checkpoint: snapshot holds state for {len(objects)} "
+                f"registered object(s) but {len(_registered)} are "
+                f"registered now — register() the same objects in the same "
+                f"order before train_epoch_range()")
+        for obj, sd in zip(_registered, objects):
+            obj.set_state_dict(sd)
         self.restored_from = epoch
         return epoch + 1
 

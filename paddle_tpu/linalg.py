@@ -1,0 +1,13 @@
+"""``paddle.linalg`` namespace.
+
+Parity: ``/root/reference/python/paddle/linalg.py`` — the 2.1-era surface
+re-exports {cholesky, norm, inv} from ``tensor.linalg``; the kernels lower
+to XLA's decompositions (potrf/getri roles of cholesky_op.cc /
+inverse_op.cc) and are differentiable through the registry's auto-vjp.
+"""
+
+from .tensor_api import cholesky  # noqa: F401
+from .tensor_api import norm  # noqa: F401
+from .tensor_api import inverse as inv  # noqa: F401
+
+__all__ = ["cholesky", "norm", "inv"]

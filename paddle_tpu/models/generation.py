@@ -84,6 +84,52 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps):
     return x, k_cache, v_cache
 
 
+def _decoder_setup(model, what="KV-cache decode"):
+    """Shared decode substrate for greedy/sampling and beam search: the
+    flat param pytree and a ``make_run(p)`` returning the cached forward
+    ``run(tokens, pos, kc, vc) -> (logits, kc, vc)``."""
+    cfg = model.cfg
+    if cfg.use_parallel:
+        raise NotImplementedError(
+            f"{what} is wired for the non-TP model; shard the "
+            "generate fn with GSPMD for mp decode")
+    gpt = model.gpt
+    eps = cfg.layer_norm_eps
+    n_heads = cfg.num_heads
+    params = {
+        "wte": gpt.embeddings.word_embeddings.weight._array,
+        "wpe": gpt.embeddings.position_embeddings.weight._array,
+        "lnf_g": gpt.ln_f.weight._array, "lnf_b": gpt.ln_f.bias._array,
+        "blocks": [_block_params(b) for b in gpt.blocks],
+    }
+
+    def make_run(p):
+        def logits_from(x):
+            x = _ln(x, p["lnf_g"], p["lnf_b"], eps)
+            return (x @ p["wte"].T).astype(jnp.float32)
+
+        def run(tokens, pos, kc, vc):
+            t = tokens.shape[1]
+            x = p["wte"][tokens] + p["wpe"][pos + jnp.arange(t)]
+            new_k, new_v = [], []
+            for li, bp in enumerate(p["blocks"]):
+                x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
+                                       n_heads, eps)
+                new_k.append(k1)
+                new_v.append(v1)
+            return logits_from(x), jnp.stack(new_k), jnp.stack(new_v)
+
+        return run
+
+    return params, make_run
+
+
+def _empty_cache(cfg, b, s_max, dtype):
+    hd = cfg.hidden_size // cfg.num_heads
+    shape = (cfg.num_layers, b, cfg.num_heads, s_max, hd)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
 def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
                       top_k: int = 0, greedy: bool = True):
     """Compile ``(ids, seed) -> generated ids`` for a GPTForPretraining.
@@ -92,24 +138,7 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
     (B, prompt_len + max_new_tokens) with the continuation appended.
     """
     cfg = model.cfg
-    if cfg.use_parallel:
-        raise NotImplementedError(
-            "KV-cache decode is wired for the non-TP model; shard the "
-            "generate fn with GSPMD for mp decode")
-    gpt = model.gpt
-    eps = cfg.layer_norm_eps
-    n_heads = cfg.num_heads
-    L = cfg.num_layers
-    params = {
-        "wte": gpt.embeddings.word_embeddings.weight._array,
-        "wpe": gpt.embeddings.position_embeddings.weight._array,
-        "lnf_g": gpt.ln_f.weight._array, "lnf_b": gpt.ln_f.bias._array,
-        "blocks": [_block_params(b) for b in gpt.blocks],
-    }
-
-    def logits_from(x, p):
-        x = _ln(x, p["lnf_g"], p["lnf_b"], eps)
-        return (x @ p["wte"].T).astype(jnp.float32)
+    params, make_run = _decoder_setup(model)
 
     def sample(logits, key):
         if greedy:
@@ -123,23 +152,8 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
     @functools.partial(jax.jit, static_argnums=())
     def gen(p, ids, seed):
         b, t0 = ids.shape
-        s_max = t0 + max_new_tokens
-        hd = cfg.hidden_size // n_heads
-        dt = p["wte"].dtype
-        kc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
-        vc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
-
-        def run(tokens, pos, kc, vc):
-            t = tokens.shape[1]
-            x = p["wte"][tokens] + p["wpe"][pos + jnp.arange(t)]
-            new_k, new_v = [], []
-            for li, bp in enumerate(p["blocks"]):
-                x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
-                                       n_heads, eps)
-                new_k.append(k1)
-                new_v.append(v1)
-            return logits_from(x, p), jnp.stack(new_k), jnp.stack(new_v)
-
+        kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype)
+        run = make_run(p)
         logits, kc, vc = run(ids, 0, kc, vc)
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
@@ -197,46 +211,17 @@ def build_beam_search_fn(model, max_new_tokens: int, beam_size: int = 4,
     continuation keeps the score; the emitted token stays EOS).
     """
     cfg = model.cfg
-    if cfg.use_parallel:
-        raise NotImplementedError("beam search is wired for the non-TP model")
-    gpt = model.gpt
-    eps = cfg.layer_norm_eps
-    n_heads = cfg.num_heads
-    L = cfg.num_layers
     K = beam_size
-    params = {
-        "wte": gpt.embeddings.word_embeddings.weight._array,
-        "wpe": gpt.embeddings.position_embeddings.weight._array,
-        "lnf_g": gpt.ln_f.weight._array, "lnf_b": gpt.ln_f.bias._array,
-        "blocks": [_block_params(b) for b in gpt.blocks],
-    }
-
-    def logits_from(x, p):
-        x = _ln(x, p["lnf_g"], p["lnf_b"], eps)
-        return (x @ p["wte"].T).astype(jnp.float32)
+    params, make_run = _decoder_setup(model, what="beam search")
 
     @jax.jit
     def gen(p, ids):
         b, t0 = ids.shape
-        s_max = t0 + max_new_tokens
-        hd = cfg.hidden_size // n_heads
-        dt = p["wte"].dtype
         V = p["wte"].shape[0]
-
-        def run(tokens, pos, kc, vc):
-            t = tokens.shape[1]
-            x = p["wte"][tokens] + p["wpe"][pos + jnp.arange(t)]
-            new_k, new_v = [], []
-            for li, bp in enumerate(p["blocks"]):
-                x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
-                                       n_heads, eps)
-                new_k.append(k1)
-                new_v.append(v1)
-            return logits_from(x, p), jnp.stack(new_k), jnp.stack(new_v)
+        run = make_run(p)
 
         # prefill on the B prompts, then expand to B*K beams
-        kc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
-        vc = jnp.zeros((L, b, n_heads, s_max, hd), dt)
+        kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype)
         logits, kc, vc = run(ids, 0, kc, vc)
         lp = jax.nn.log_softmax(logits[:, -1])            # (B, V)
         scores0, tok0 = lax.top_k(lp, K)                   # (B, K)

@@ -59,7 +59,17 @@ def dispatch_static(
             ]
             for slot, vs in norm_in.items()
         }
-        out_shapes = _probe_out_slots(op_def, ins_structs, attrs)
+        try:
+            out_shapes = _probe_out_slots(op_def, ins_structs, attrs)
+        except Exception as e:
+            # surface the failing op with its input shapes; the live
+            # traceback already points at the user's call site
+            # (op_call_stack.cc error-provenance role)
+            shapes = {s: [tuple(v.shape) for v in vs]
+                      for s, vs in norm_in.items()}
+            raise RuntimeError(
+                f"op {op_type!r} failed shape inference for inputs "
+                f"{shapes}: {e}") from e
         outputs = {}
         for slot, vals in out_shapes.items():
             n = len(vals) if isinstance(vals, (list, tuple)) else 1

@@ -286,3 +286,20 @@ def addmm_kernel(ins, attrs):
 def dot_kernel(ins, attrs):
     x, y = ins["X"], ins["Y"]
     return {"Out": jnp.sum(x * y, axis=-1)}
+
+
+@register_op("cholesky")
+def cholesky_kernel(ins, attrs):
+    """Parity: cholesky_op.cc (cuSOLVER potrf role) — XLA lowers
+    jnp.linalg.cholesky; differentiable via auto-vjp."""
+    x = ins["X"]
+    l = jnp.linalg.cholesky(x)
+    if attrs.get("upper", False):
+        l = jnp.swapaxes(l, -1, -2)
+    return {"Out": l}
+
+
+@register_op("inverse")
+def inverse_kernel(ins, attrs):
+    """Parity: inverse_op.cc (cuBLAS getri role) — XLA LU path."""
+    return {"Output": jnp.linalg.inv(ins["Input"])}

@@ -239,7 +239,11 @@ class Executor:
                     if vals or slot in op_def.list_slots:
                         ins[slot] = vals
                 r = jax.random.fold_in(rng, i) if op_def.needs_rng else None
-                outs = registry.run_kernel(op_def, ins, op.attrs, rng=r)
+                try:
+                    outs = registry.run_kernel(op_def, ins, op.attrs, rng=r)
+                except Exception as e:
+                    # tracing failure: annotate with the op + creation site
+                    fw.raise_with_op_site(op, "failed to lower", e)
                 if check_nan:
                     oks.append(nan_inf.op_all_finite(outs))
                 for slot, names in op.outputs.items():

@@ -45,6 +45,7 @@ __all__ = [
     "flops", "increment", "is_tensor", "shape", "real", "create_parameter",
     "create_array", "array_write", "array_read", "array_length",
     "multiplex", "histogram", "bincount", "cross", "diag", "mv",
+    "cholesky", "inverse",
 ]
 
 
@@ -282,6 +283,16 @@ def dot(x, y, name=None):
 
 def mv(x, vec, name=None):
     return _d("matmul_v2", {"X": [x], "Y": [vec]}, {})
+
+
+def cholesky(x, upper=False, name=None):
+    """Parity: tensor/linalg.py cholesky:735."""
+    return _d("cholesky", {"X": [x]}, {"upper": bool(upper)})
+
+
+def inverse(x, name=None):
+    """Parity: tensor/math.py inverse (inverse_op.cc)."""
+    return _d("inverse", {"Input": [x]}, {}, slot="Output")
 
 
 def equal_all(x, y, name=None):

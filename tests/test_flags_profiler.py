@@ -112,3 +112,36 @@ def test_tpu_matmul_precision_flag():
     paddle.set_flags({"FLAGS_tpu_matmul_precision": "float32"})
     assert jax.config.jax_default_matmul_precision == "float32"
     paddle.set_flags({"FLAGS_tpu_matmul_precision": "default"})
+
+
+def test_op_error_provenance():
+    """A kernel that fails to lower reports the op and, with
+    FLAGS_call_stack_level=2, the operator creation stack
+    (op_call_stack.cc role)."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.framework import program as fw
+
+    paddle.enable_static()
+    try:
+        paddle.set_flags({"FLAGS_call_stack_level": 2})
+        main, startup = fw.Program(), fw.Program()
+        with fw.program_guard(main, startup):
+            x = static.data("xa", [2, 3], "float32")
+            y = static.data("yb", [5, 4], "float32")
+            # shape-incompatible matmul fails at build-time shape inference
+            main.global_block().create_var(name="bad_out")
+            with pytest.raises(RuntimeError) as ei:
+                main.global_block().append_op(
+                    type="matmul_v2", inputs={"X": ["xa"], "Y": ["yb"]},
+                    outputs={"Out": ["bad_out"]}, attrs={})
+        msg = str(ei.value)
+        assert "matmul_v2" in msg
+        assert "operator creation stack" in msg
+        assert "test_flags_profiler.py" in msg  # points at THIS file
+    finally:
+        paddle.set_flags({"FLAGS_call_stack_level": 1})
+        paddle.disable_static()

@@ -187,6 +187,22 @@ binary("elementwise_floordiv", lambda x, y: np.floor_divide(x, y), "pos",
 binary("maximum", lambda x, y: np.maximum(x, y))
 binary("minimum", lambda x, y: np.minimum(x, y))
 binary("kron", lambda x, y: np.kron(x, y), grad=("X", "Y"))
+_spd = (lambda a: a @ a.T + 3.0 * np.eye(4, dtype="float32"))(
+    R(41).randn(4, 4).astype("float32"))
+case("cholesky",
+     inputs={"X": _spd},
+     refs={"Out": np.linalg.cholesky(_spd)},
+     grad=("X",), gatol=2e-2, grtol=2e-2)
+case("cholesky",
+     inputs={"X": _spd}, attrs={"upper": True},
+     refs={"Out": np.linalg.cholesky(_spd).T.copy()},
+     tag="upper")
+_invx = R(42).randn(3, 3).astype("float32") + 4.0 * np.eye(3, dtype="float32")
+case("inverse",
+     inputs={"Input": _invx},
+     refs={"Output": np.linalg.inv(_invx)},
+     out="Output", grad=("Input",), gatol=2e-2, grtol=2e-2)
+
 case("cross",
      inputs={"X": R(9).randn(4, 3).astype("float32"),
              "Y": R(10).randn(4, 3).astype("float32")},
