@@ -88,3 +88,107 @@ from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
 from .io_api import batch, load, save  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .dygraph.parallel import DataParallel  # noqa: F401
+
+# -- top-level surface completeness (reference python/paddle/__init__.py) --
+from . import hub  # noqa: F401
+from .nn import ParamAttr  # noqa: F401
+from .framework.dtype import DataType as dtype  # noqa: F401
+from .framework.place import NPUPlace  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+
+VarBase = Tensor  # legacy alias (pre-2.2 name for the eager tensor)
+
+in_dynamic_mode = in_dygraph_mode
+enable_dygraph = disable_static
+disable_dygraph = enable_static
+
+# CUDA-named RNG surface maps onto the device-agnostic seed chain
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference returns None when not compiled with it)."""
+    return None
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def set_default_dtype(d):
+    """Parity: paddle.set_default_dtype — governs float-literal creation."""
+    from .framework import dtype as _dt
+
+    _dt.set_default_dtype(d)
+
+
+def get_default_dtype():
+    from .framework import dtype as _dt
+
+    return _dt.get_default_dtype()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Parity: paddle.set_printoptions — numpy-backed display options."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Parity: paddle.summary — layer/param table for a Layer."""
+    import numpy as _np
+
+    total = 0
+    trainable = 0
+    lines = ["-" * 64,
+             f"{'Layer (type)':<38}{'Param shape':<16}{'Param #':>10}",
+             "=" * 64]
+    for name, p in net.named_parameters():
+        n = int(_np.prod(p.shape))
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        lines.append(f"{name:<38}{str(tuple(p.shape)):<16}{n:>10,}")
+    lines += ["=" * 64, f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}", "-" * 64]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def check_shape(shape):
+    """Parity: paddle.check_shape — validate a shape list."""
+    for s in shape:
+        if s is not None and not isinstance(s, (int,)):
+            raise TypeError(f"shape entries must be ints/None, got {s!r}")
+
+
+def monkey_patch_math_varbase():
+    """No-op: operator overloads are built into Tensor here (the reference
+    patches VarBase at import time; exported for import parity)."""
+
+
+def monkey_patch_variable():
+    """No-op: Variable operator overloads are built in (import parity)."""

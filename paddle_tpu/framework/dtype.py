@@ -116,3 +116,21 @@ def is_floating(dtype) -> bool:
 
 def is_integer(dtype) -> bool:
     return convert_dtype(dtype) in _INT_DTYPES
+
+
+_DEFAULT_DTYPE = "float32"
+
+
+def set_default_dtype(d):
+    """Parity: paddle.set_default_dtype (float types only, like the
+    reference's framework.set_default_dtype)."""
+    global _DEFAULT_DTYPE
+    d = convert_dtype(d)
+    if d not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(
+            f"default dtype must be a float type, got {d!r}")
+    _DEFAULT_DTYPE = d
+
+
+def get_default_dtype() -> str:
+    return _DEFAULT_DTYPE

@@ -74,6 +74,11 @@ class XPUPlace(TPUPlace):
     pass
 
 
+class NPUPlace(TPUPlace):
+    """Accepted for API parity (the fork's Ascend place); resolves to the
+    default accelerator like CUDAPlace."""
+
+
 _state = threading.local()
 
 

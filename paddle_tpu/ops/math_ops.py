@@ -303,3 +303,153 @@ def cholesky_kernel(ins, attrs):
 def inverse_kernel(ins, attrs):
     """Parity: inverse_op.cc (cuBLAS getri role) — XLA LU path."""
     return {"Output": jnp.linalg.inv(ins["Input"])}
+
+
+# ---------------------------------------------------------------------------
+# surface-completeness batch (reference top-level paddle.* parity)
+# ---------------------------------------------------------------------------
+
+register_op("erf")(_unary(jax.lax.erf))
+register_op("expm1")(_unary(jnp.expm1))
+register_op("lgamma")(_unary(jax.lax.lgamma))
+register_op("digamma")(_unary(jax.lax.digamma))
+register_op("trunc", no_grad=True)(_unary(jnp.trunc))
+register_op("conj")(_unary(jnp.conj))
+register_op("real", no_grad=True)(_unary(jnp.real))
+register_op("imag", no_grad=True)(_unary(jnp.imag))
+register_op("atan2")(_binary(jnp.arctan2))
+
+register_op("bitwise_and", nondiff_slots=("X", "Y"), no_grad=True)(
+    _binary(jnp.bitwise_and))
+register_op("bitwise_or", nondiff_slots=("X", "Y"), no_grad=True)(
+    _binary(jnp.bitwise_or))
+register_op("bitwise_xor", nondiff_slots=("X", "Y"), no_grad=True)(
+    _binary(jnp.bitwise_xor))
+register_op("bitwise_not", nondiff_slots=("X",), no_grad=True)(
+    _unary(jnp.bitwise_not))
+
+
+@register_op("stanh")
+def stanh_kernel(ins, attrs):
+    """Parity: stanh_op.cc — b * tanh(a * x)."""
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * ins["X"])}
+
+
+@register_op("logsumexp")
+def logsumexp_kernel(ins, attrs):
+    axis = attrs.get("axis")
+    keepdim = bool(attrs.get("keepdim", False))
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if attrs.get("reduce_all", False):
+        ax = None
+    return {"Out": jax.nn.logsumexp(ins["X"], axis=ax, keepdims=keepdim)}
+
+
+@register_op("trace")
+def trace_kernel(ins, attrs):
+    return {"Out": jnp.trace(ins["Input"],
+                             offset=attrs.get("offset", 0),
+                             axis1=attrs.get("axis1", 0),
+                             axis2=attrs.get("axis2", 1))}
+
+
+@register_op("diagonal")
+def diagonal_kernel(ins, attrs):
+    return {"Out": jnp.diagonal(ins["Input"],
+                                offset=attrs.get("offset", 0),
+                                axis1=attrs.get("axis1", 0),
+                                axis2=attrs.get("axis2", 1))}
+
+
+@register_op("diagflat")
+def diagflat_kernel(ins, attrs):
+    return {"Out": jnp.diagflat(ins["X"], k=attrs.get("offset", 0))}
+
+
+@register_op("reduce_std")
+def reduce_std_kernel(ins, attrs):
+    axis = attrs.get("dim")
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if attrs.get("reduce_all", False):
+        ax = None
+    ddof = 1 if attrs.get("unbiased", True) else 0
+    return {"Out": jnp.std(ins["X"], axis=ax, ddof=ddof,
+                           keepdims=bool(attrs.get("keep_dim", False)))}
+
+
+@register_op("reduce_var")
+def reduce_var_kernel(ins, attrs):
+    axis = attrs.get("dim")
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if attrs.get("reduce_all", False):
+        ax = None
+    ddof = 1 if attrs.get("unbiased", True) else 0
+    return {"Out": jnp.var(ins["X"], axis=ax, ddof=ddof,
+                           keepdims=bool(attrs.get("keep_dim", False)))}
+
+
+@register_op("median", no_grad=True)
+def median_kernel(ins, attrs):
+    """Parity: paddle.median (kth-value formulation) — grad exempt like the
+    reference's non-differentiable index-median path."""
+    axis = attrs.get("axis")
+    keepdim = bool(attrs.get("keepdim", False))
+    return {"Out": jnp.median(ins["X"], axis=axis, keepdims=keepdim)}
+
+
+@register_op("reverse")
+def reverse_kernel(ins, attrs):
+    ax = attrs.get("axis")
+    ax = tuple(ax) if isinstance(ax, (list, tuple)) else (ax,)
+    return {"Out": jnp.flip(ins["X"], axis=ax)}
+
+
+@register_op("multinomial", needs_rng=True, nondiff_slots=("X",),
+             no_grad=True)
+def multinomial_kernel(ins, attrs, rng=None):
+    """Parity: multinomial_op.cc — with-replacement categorical draws.
+    Without-replacement sampling needs a Gumbel top-k; raise for now."""
+    n = attrs.get("num_samples", 1)
+    if not attrs.get("replacement", False) and n > 1:
+        x = ins["X"]
+        # Gumbel top-k: ONE gumbel per category, top-n of (logits + g) is
+        # an exact without-replacement sample (no duplicate indices)
+        g = jax.random.gumbel(rng, x.shape)
+        logits = jnp.log(jnp.maximum(x, 1e-30))
+        _, idx = jax.lax.top_k(logits + g, n)
+        return {"Out": idx.astype(jnp.int64)}
+    logits = jnp.log(jnp.maximum(ins["X"], 1e-30))
+    draws = jax.random.categorical(
+        rng, logits[..., None, :], axis=-1,
+        shape=logits.shape[:-1] + (n,))
+    return {"Out": draws.astype(jnp.int64)}
+
+
+@register_op("index_sample", nondiff_slots=("Index",))
+def index_sample_kernel(ins, attrs):
+    """Parity: index_sample_op.cc — out[i, j] = x[i, index[i, j]]."""
+    return {"Out": jnp.take_along_axis(ins["X"], ins["Index"], axis=1)}
+
+
+@register_op("shard_index", nondiff_slots=("X",), no_grad=True)
+def shard_index_kernel(ins, attrs):
+    """Parity: shard_index_op.cc — remap ids into a shard-local range."""
+    x = ins["X"]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    size = (index_num + nshards - 1) // nshards
+    in_shard = x // size == shard_id
+    return {"Out": jnp.where(in_shard, x % size,
+                             jnp.asarray(ignore, x.dtype))}
+
+
+@register_op("crop_tensor")
+def crop_tensor_kernel(ins, attrs):
+    x = ins["X"]
+    offsets = attrs.get("offsets", [0] * x.ndim)
+    shape = attrs.get("shape")
+    return {"Out": jax.lax.dynamic_slice(x, tuple(offsets), tuple(shape))}

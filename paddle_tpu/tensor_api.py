@@ -45,7 +45,16 @@ __all__ = [
     "flops", "increment", "is_tensor", "shape", "real", "create_parameter",
     "create_array", "array_write", "array_read", "array_length",
     "multiplex", "histogram", "bincount", "cross", "diag", "mv",
-    "cholesky", "inverse",
+    "cholesky", "inverse", "erf", "expm1", "lgamma", "digamma", "trunc",
+    "conj", "real", "imag", "atan2", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "stanh", "logsumexp", "trace",
+    "diagonal", "diagflat", "std", "var", "median", "reverse",
+    "multinomial", "index_sample", "scatter_nd",
+    "shard_index", "crop", "crop_tensor", "neg", "all", "any",
+    "floor_mod", "is_empty", "rank", "broadcast_shape",
+    "broadcast_tensors", "standard_normal", "unbind", "tolist",
+    "assign", "addmm", "reshape_", "squeeze_", "unsqueeze_", "tanh_",
+    "scatter_",
 ]
 
 
@@ -144,9 +153,13 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
     if end is None:
         start, end = 0, start
     if dtype is None:
+        # NB: builtins.all — paddle.all shadows the builtin in this module
+        import builtins
+
         dtype = (
             "int64"
-            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            if builtins.all(isinstance(v, (int, np.integer))
+                            for v in (start, end, step))
             else "float32"
         )
     return _d(
@@ -344,10 +357,6 @@ reciprocal = _unop("reciprocal")
 isnan = _unop("isnan_v2")
 isinf = _unop("isinf_v2")
 isfinite = _unop("isfinite_v2")
-
-
-def real(x, name=None):
-    return x
 
 
 def clip(x, min=None, max=None, name=None):
@@ -860,3 +869,308 @@ _patch(fw.Variable)
 fw.Variable.__hash__ = lambda self: id(self)
 fw.Variable.cast = lambda self, dtype: cast(self, dtype)
 Tensor.numpy = Tensor.numpy  # keep explicit
+
+
+# ---------------------------------------------------------------------------
+# surface-completeness batch (reference python/paddle/__init__.py parity)
+# ---------------------------------------------------------------------------
+
+
+def erf(x, name=None):
+    return _d("erf", {"X": [x]})
+
+
+def expm1(x, name=None):
+    return _d("expm1", {"X": [x]})
+
+
+def lgamma(x, name=None):
+    return _d("lgamma", {"X": [x]})
+
+
+def digamma(x, name=None):
+    return _d("digamma", {"X": [x]})
+
+
+def trunc(x, name=None):
+    return _d("trunc", {"X": [x]})
+
+
+def conj(x, name=None):
+    return _d("conj", {"X": [x]})
+
+
+def real(x, name=None):
+    return _d("real", {"X": [x]})
+
+
+def imag(x, name=None):
+    return _d("imag", {"X": [x]})
+
+
+def atan2(x, y, name=None):
+    return _d("atan2", {"X": [x], "Y": [y]})
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _d("bitwise_and", {"X": [x], "Y": [y]})
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _d("bitwise_or", {"X": [x], "Y": [y]})
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _d("bitwise_xor", {"X": [x], "Y": [y]})
+
+
+def bitwise_not(x, out=None, name=None):
+    return _d("bitwise_not", {"X": [x]})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _d("stanh", {"X": [x]}, {"scale_a": scale_a, "scale_b": scale_b})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    attrs = {"keepdim": keepdim}
+    if axis is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["axis"] = [axis] if isinstance(axis, int) else list(axis)
+    return _d("logsumexp", {"X": [x]}, attrs)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _d("trace", {"Input": [x]},
+              {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _d("diagonal", {"Input": [x]},
+              {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def diagflat(x, offset=0, name=None):
+    return _d("diagflat", {"X": [x]}, {"offset": offset})
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    attrs = {"unbiased": bool(unbiased), "keep_dim": keepdim}
+    if axis is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+    return _d("reduce_std", {"X": [x]}, attrs)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    attrs = {"unbiased": bool(unbiased), "keep_dim": keepdim}
+    if axis is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+    return _d("reduce_var", {"X": [x]}, attrs)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _d("median", {"X": [x]}, {"axis": axis, "keepdim": keepdim})
+
+
+def reverse(x, axis, name=None):
+    return _d("reverse", {"X": [x]},
+              {"axis": [axis] if isinstance(axis, int) else list(axis)})
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _d("multinomial", {"X": [x]},
+              {"num_samples": num_samples, "replacement": bool(replacement)})
+
+
+def index_sample(x, index):
+    return _d("index_sample", {"X": [x], "Index": [index]})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Parity: paddle.scatter_nd — scatter into zeros of ``shape``."""
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id({shard_id}) must be in [0, nshards({nshards}))")
+    return _d("shard_index", {"X": [input]},
+              {"index_num": index_num, "nshards": nshards,
+               "shard_id": shard_id, "ignore_value": ignore_value})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    xs = list(x.shape)
+    offsets = [int(o) for o in offsets] if offsets is not None else [0] * len(xs)
+    shape = list(shape) if shape is not None else xs
+    # paddle semantics: -1/None means "to the end" = input dim minus offset
+    shape = [xs[i] - offsets[i] if (s is None or int(s) < 0) else int(s)
+             for i, s in enumerate(shape)]
+    return _d("crop_tensor", {"X": [x]},
+              {"offsets": offsets, "shape": shape})
+
+
+crop_tensor = crop
+
+
+def neg(x, name=None):
+    return scale(x, scale=-1.0)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    attrs = {"keep_dim": keepdim}
+    if axis is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+    return _d("reduce_all", {"X": [x]}, attrs)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    attrs = {"keep_dim": keepdim}
+    if axis is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [axis] if isinstance(axis, int) else list(axis)
+    return _d("reduce_any", {"X": [x]}, attrs)
+
+
+def floor_mod(x, y, name=None):
+    return mod(x, y)
+
+
+def is_empty(x, name=None):
+    import numpy as _np
+
+    n = int(_np.prod(x.shape)) if 0 not in x.shape else 0
+    return full([1], n == 0, dtype="bool")
+
+
+def rank(input):
+    return to_tensor(np.asarray(len(input.shape), "int32"))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(input, name=None):
+    tgt = list(np.broadcast_shapes(*[tuple(t.shape) for t in input]))
+    return [broadcast_to(t, tgt) for t in input]
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype=dtype)
+
+
+def unbind(input, axis=0):
+    """Parity: paddle.unbind — split + squeeze along ``axis``."""
+    n = input.shape[axis]
+    parts = split(input, n, axis=axis)
+    return [squeeze(p, [axis]) for p in parts]
+
+
+def tolist(x):
+    return np.asarray(x.numpy()).tolist()
+
+
+def assign(x, output=None):
+    """Parity: paddle.assign (assign_op.cc) — copy into ``output`` or a new
+    tensor."""
+    if not is_tensor(x):
+        x = _wrap(x)
+    out = _d("assign", {"X": [x]})
+    if output is not None:
+        output.set_value(out.numpy() if hasattr(out, "numpy") else out)
+        return output
+    return out
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """Parity: paddle.addmm (addmm_op.cc): beta*input + alpha*(x @ y)."""
+    return _d("addmm", {"Input": [input], "X": [x], "Y": [y]},
+              {"Beta": float(beta), "Alpha": float(alpha)})
+
+
+# -- in-place surface variants (reference *_ API) ---------------------------
+# Tape-safe: when the receiver has gradient history, the mutation goes
+# through Tensor._taped_inplace (version-bump clone + consumer re-pointing,
+# so the record's outputs re-home onto the receiver and backward stays
+# correct); otherwise the array is rebound directly (same split scale_ /
+# fill_ use, dygraph/tensor.py).
+
+
+def _inplace_apply(x, fn, tensor_inputs, name):
+    from .dygraph import tracer as _tr
+
+    if _tr.has_grad() and x.grad_node is not None:
+        return x._taped_inplace(fn, list(tensor_inputs), name=name)
+    import jax.numpy as _jnp  # noqa: F401  (fn may close over jnp)
+
+    x._array = fn(x._array, *[t._array for t in tensor_inputs])
+    return x
+
+
+def _resolve_reshape(shape, cur_shape):
+    """paddle reshape semantics: 0 copies the input dim, one -1 is
+    inferred."""
+    out = [cur_shape[i] if int(s) == 0 else int(s)
+           for i, s in enumerate(shape)]
+    if out.count(-1) > 1:
+        raise ValueError(f"only one -1 allowed in shape, got {shape}")
+    if -1 in out:
+        import numpy as _np
+
+        known = int(_np.prod([s for s in out if s != -1])) or 1
+        total = int(_np.prod(cur_shape)) if cur_shape else 1
+        out[out.index(-1)] = total // known
+    return out
+
+
+def reshape_(x, shape, name=None):
+    import jax.numpy as jnp
+
+    tgt = _resolve_reshape(list(shape), list(x.shape))
+    return _inplace_apply(x, lambda a: jnp.reshape(a, tgt), (), "reshape_")
+
+
+def squeeze_(x, axis=None, name=None):
+    import jax.numpy as jnp
+
+    ax = (tuple(axis) if isinstance(axis, (list, tuple))
+          else (axis,) if axis is not None else None)
+    return _inplace_apply(x, lambda a: jnp.squeeze(a, axis=ax), (),
+                          "squeeze_")
+
+
+def unsqueeze_(x, axis, name=None):
+    import jax.numpy as jnp
+
+    axes = sorted(axis if isinstance(axis, (list, tuple)) else [axis])
+
+    def fn(a):
+        for ax in axes:
+            a = jnp.expand_dims(a, ax)
+        return a
+
+    return _inplace_apply(x, fn, (), "unsqueeze_")
+
+
+def tanh_(x, name=None):
+    import jax.numpy as jnp
+
+    return _inplace_apply(x, jnp.tanh, (), "tanh_")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    def fn(a, idx, upd):
+        return a.at[idx].set(upd) if overwrite else a.at[idx].add(upd)
+
+    return _inplace_apply(x, fn, (index, updates), "scatter_")

@@ -187,6 +187,89 @@ binary("elementwise_floordiv", lambda x, y: np.floor_divide(x, y), "pos",
 binary("maximum", lambda x, y: np.maximum(x, y))
 binary("minimum", lambda x, y: np.minimum(x, y))
 binary("kron", lambda x, y: np.kron(x, y), grad=("X", "Y"))
+# ---- surface-completeness batch -------------------------------------------
+unary("erf", ERF)
+unary("expm1", np.expm1)
+unary("lgamma", np.vectorize(math.lgamma), "pos")
+try:
+    from scipy.special import digamma as _DIGAMMA
+
+    unary("digamma", _DIGAMMA, "pos")
+except ImportError:
+    unary("digamma", None, "pos")
+unary("trunc", np.trunc, "away0", grad=False)
+unary("conj", np.conj)
+unary("real", np.real, grad=False)
+unary("imag", np.imag, grad=False)
+binary("atan2", np.arctan2, y_domain="away0")
+unary("stanh", lambda x: 1.7159 * np.tanh(0.67 * x))
+_ints = (R(71).randint(0, 255, (3, 4)).astype("int32"),
+         R(72).randint(0, 255, (3, 4)).astype("int32"))
+for _bop, _bfn in [("bitwise_and", np.bitwise_and),
+                   ("bitwise_or", np.bitwise_or),
+                   ("bitwise_xor", np.bitwise_xor)]:
+    case(_bop, inputs={"X": _ints[0], "Y": _ints[1]},
+         refs={"Out": _bfn(_ints[0], _ints[1])})
+case("bitwise_not", inputs={"X": _ints[0]},
+     refs={"Out": np.bitwise_not(_ints[0])})
+
+_lse_x = R(73).randn(3, 4).astype("float32")
+
+
+def _np_lse(a, axis=None):
+    m = np.max(a, axis=axis, keepdims=True)
+    out = np.log(np.sum(np.exp(a - m), axis=axis, keepdims=True)) + m
+    return out.reshape([s for i, s in enumerate(a.shape) if i != axis]) \
+        if axis is not None else np.float64(out.reshape(()))
+
+
+case("logsumexp", inputs={"X": _lse_x}, attrs={"axis": [1]},
+     refs={"Out": _np_lse(_lse_x.astype("float64"), axis=1).astype("float32")},
+     grad=("X",))
+case("logsumexp", inputs={"X": _lse_x}, attrs={"reduce_all": True},
+     refs={"Out": np.float32(_np_lse(_lse_x.astype("float64")))},
+     tag="all")
+
+_tr_x = R(74).randn(4, 4).astype("float32")
+case("trace", inputs={"Input": _tr_x},
+     refs={"Out": np.float32(np.trace(_tr_x))}, grad=("Input",))
+case("diagonal", inputs={"Input": _tr_x}, attrs={"offset": 1},
+     refs={"Out": np.diagonal(_tr_x, offset=1)}, grad=("Input",))
+_df_x = R(75).randn(5).astype("float32")
+case("diagflat", inputs={"X": _df_x},
+     refs={"Out": np.diagflat(_df_x)}, grad=("X",))
+
+_sv_x = R(76).randn(3, 5).astype("float32")
+case("reduce_std", inputs={"X": _sv_x}, attrs={"dim": [1], "unbiased": True},
+     refs={"Out": np.std(_sv_x.astype("float64"), axis=1,
+                         ddof=1).astype("float32")},
+     grad=("X",))
+case("reduce_var", inputs={"X": _sv_x},
+     attrs={"reduce_all": True, "unbiased": False},
+     refs={"Out": np.float32(np.var(_sv_x.astype("float64")))},
+     grad=("X",))
+case("median", inputs={"X": _sv_x}, attrs={"axis": 1},
+     refs={"Out": np.median(_sv_x, axis=1)})
+case("reverse", inputs={"X": _sv_x}, attrs={"axis": [1]},
+     refs={"Out": _sv_x[:, ::-1].copy()}, grad=("X",))
+
+_is_x = R(77).randn(3, 5).astype("float32")
+_is_i = R(78).randint(0, 5, (3, 2)).astype("int64")
+case("index_sample", inputs={"X": _is_x, "Index": _is_i},
+     refs={"Out": np.take_along_axis(_is_x, _is_i, axis=1)}, grad=("X",))
+
+_sh_x = R(79).randint(0, 20, (6, 1)).astype("int64")
+_sh_size = (20 + 2 - 1) // 2
+case("shard_index", inputs={"X": _sh_x},
+     attrs={"index_num": 20, "nshards": 2, "shard_id": 0,
+            "ignore_value": -1},
+     refs={"Out": np.where(_sh_x // _sh_size == 0, _sh_x % _sh_size, -1)})
+
+_cr_x = R(80).randn(4, 5).astype("float32")
+case("crop_tensor", inputs={"X": _cr_x},
+     attrs={"offsets": [1, 2], "shape": [2, 3]},
+     refs={"Out": _cr_x[1:3, 2:5].copy()}, grad=("X",))
+
 _spd = (lambda a: a @ a.T + 3.0 * np.eye(4, dtype="float32"))(
     R(41).randn(4, 4).astype("float32"))
 case("cholesky",
@@ -703,6 +786,8 @@ STOCHASTIC = {
 # ---------------------------------------------------------------------------
 
 EXEMPT = {
+    "multinomial": "random categorical draws (seeded PRNG; shape/dtype "
+                   "exercised via paddle.multinomial in test_ops)",
     # collectives need an initialized mesh/process group; exercised by
     # tests/test_distributed.py over the 8-device CPU mesh
     "c_allgather": "collective (test_distributed)",

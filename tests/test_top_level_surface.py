@@ -1,0 +1,126 @@
+"""Top-level ``paddle.*`` surface parity.
+
+The name list below is the full export surface of the reference's
+``python/paddle/__init__.py`` (from-imports + __all__), snapshotted so the
+suite stays self-contained.  Every name must resolve on paddle_tpu.
+"""
+
+import paddle_tpu as paddle
+
+REFERENCE_TOP_LEVEL = ['CPUPlace', 'CUDAPinnedPlace', 'CUDAPlace', 'DataParallel', 'Model', 'NPUPlace', 'ParamAttr', 'Tensor', 'VarBase', 'XPUPlace', 'abs', 'acos', 'add', 'add_n', 'addmm', 'all', 'allclose', 'any', 'arange', 'argmax', 'argmin', 'argsort', 'asin', 'assign', 'atan', 'atan2', 'batch', 'bernoulli', 'bfloat16', 'bitwise_and', 'bitwise_not', 'bitwise_or', 'bitwise_xor', 'bmm', 'bool', 'broadcast_shape', 'broadcast_tensors', 'broadcast_to', 'callbacks', 'cast', 'ceil', 'check_shape', 'cholesky', 'chunk', 'clip', 'complex128', 'complex64', 'concat', 'conj', 'cos', 'cosh', 'create_parameter', 'crop', 'crop_tensor', 'cross', 'cumsum', 'diag', 'diagflat', 'diagonal', 'digamma', 'disable_dygraph', 'disable_static', 'dist', 'divide', 'dot', 'dtype', 'empty', 'empty_like', 'enable_dygraph', 'enable_static', 'equal', 'equal_all', 'erf', 'exp', 'expand', 'expand_as', 'expm1', 'eye', 'flatten', 'flip', 'float16', 'float32', 'float64', 'floor', 'floor_divide', 'floor_mod', 'flops', 'full', 'full_like', 'gather', 'gather_nd', 'get_cuda_rng_state', 'get_cudnn_version', 'get_default_dtype', 'get_device', 'grad', 'greater_equal', 'greater_than', 'histogram', 'hub', 'imag', 'in_dygraph_mode', 'in_dynamic_mode', 'increment', 'index_sample', 'index_select', 'int16', 'int32', 'int64', 'int8', 'inverse', 'is_compiled_with_cuda', 'is_compiled_with_npu', 'is_compiled_with_rocm', 'is_compiled_with_xpu', 'is_empty', 'is_tensor', 'isfinite', 'isinf', 'isnan', 'kron', 'less_equal', 'less_than', 'lgamma', 'linalg', 'linspace', 'load', 'log', 'log10', 'log1p', 'log2', 'logical_and', 'logical_not', 'logical_or', 'logical_xor', 'logsumexp', 'masked_select', 'matmul', 'max', 'maximum', 'mean', 'median', 'meshgrid', 'min', 'minimum', 'mm', 'mod', 'monkey_patch_math_varbase', 'monkey_patch_variable', 'multinomial', 'multiplex', 'multiply', 'mv', 'neg', 'no_grad', 'nonzero', 'norm', 'normal', 'not_equal', 'numel', 'ones', 'ones_like', 'pow', 'prod', 'rand', 'randint', 'randn', 'randperm', 'rank', 'real', 'reciprocal', 'remainder', 'reshape', 'reshape_', 'reverse', 'roll', 'round', 'rsqrt', 'save', 'scale', 'scatter', 'scatter_', 'scatter_nd', 'scatter_nd_add', 'seed', 'set_cuda_rng_state', 'set_default_dtype', 'set_device', 'set_grad_enabled', 'set_printoptions', 'shape', 'shard_index', 'sign', 'sin', 'sinh', 'slice', 'sort', 'split', 'sqrt', 'square', 'squeeze', 'squeeze_', 'stack', 'standard_normal', 'stanh', 'std', 'strided_slice', 'subtract', 'sum', 'summary', 't', 'tan', 'tanh', 'tanh_', 'tile', 'to_tensor', 'tolist', 'topk', 'trace', 'transpose', 'tril', 'triu', 'trunc', 'uint8', 'unbind', 'uniform', 'unique', 'unsqueeze', 'unsqueeze_', 'unstack', 'var', 'where', 'zeros', 'zeros_like']
+
+
+def test_every_reference_name_resolves():
+    missing = [n for n in REFERENCE_TOP_LEVEL if not hasattr(paddle, n)]
+    assert not missing, f"missing top-level names: {missing}"
+
+
+def test_new_surface_functions_work():
+    import numpy as np
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4).astype("float32"))
+    assert paddle.logsumexp(x).shape == []
+    assert paddle.std(x, axis=1).shape == [3]
+    assert paddle.var(x).shape == []
+    assert paddle.median(x, axis=1).shape == [3]
+    assert len(paddle.unbind(x, axis=1)) == 4
+    assert paddle.all(x > -1e9).numpy()
+    assert not bool(paddle.any(x > 1e9).numpy())
+    np.testing.assert_allclose(
+        np.asarray(paddle.neg(x).numpy()), -np.asarray(x.numpy()))
+    tr = paddle.trace(paddle.to_tensor(np.eye(3, dtype="float32")))
+    assert float(tr.numpy()) == 3.0
+    y = paddle.to_tensor(np.zeros((3, 4), "float32"))
+    paddle.assign(x, y)
+    np.testing.assert_allclose(np.asarray(y.numpy()), np.asarray(x.numpy()))
+    # in-place variants mutate the receiver
+    z = paddle.to_tensor(np.zeros((2, 6), "float32"))
+    paddle.reshape_(z, [3, 4])
+    assert z.shape == [3, 4]
+    assert isinstance(paddle.tolist(z), list)
+    # multinomial draws valid indices
+    probs = paddle.to_tensor(np.ones((2, 5), "float32") / 5)
+    draws = np.asarray(paddle.multinomial(probs, num_samples=3,
+                                          replacement=True).numpy())
+    assert draws.shape == (2, 3) and (0 <= draws).all() and (draws < 5).all()
+    # summary returns totals
+    import paddle_tpu.nn as nn
+    info = paddle.summary(nn.Linear(4, 2))
+    assert info["total_params"] == 4 * 2 + 2
+
+
+def test_default_dtype_roundtrip():
+    import pytest
+
+    assert paddle.get_default_dtype() == "float32"
+    paddle.set_default_dtype("bfloat16")
+    try:
+        assert paddle.get_default_dtype() == "bfloat16"
+        with pytest.raises(TypeError):
+            paddle.set_default_dtype("int32")
+    finally:
+        paddle.set_default_dtype("float32")
+
+
+def test_hub_local_source(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def lenet(num_classes=10):\n"
+        "    'toy entrypoint'\n"
+        "    from paddle_tpu.vision.models import LeNet\n"
+        "    return LeNet(num_classes=num_classes)\n")
+    assert "lenet" in paddle.hub.list(str(tmp_path), source="local")
+    assert "toy" in paddle.hub.help(str(tmp_path), "lenet", source="local")
+    model = paddle.hub.load(str(tmp_path), "lenet", source="local",
+                            num_classes=7)
+    import numpy as np
+    out = model(paddle.to_tensor(np.zeros((1, 1, 28, 28), "float32")))
+    assert out.shape == [1, 7]
+    import pytest
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.hub.list("user/repo", source="github")
+
+
+def test_inplace_variants_gradients():
+    """tanh_ etc. must keep the tape correct: grads flow through the
+    mutation (the _taped_inplace re-homing protocol)."""
+    import numpy as np
+
+    xv = np.random.RandomState(0).randn(3, 4).astype("float32") * 0.5
+    x = paddle.to_tensor(xv.copy(), stop_gradient=False)
+    y = x * 2.0           # non-leaf with history
+    paddle.tanh_(y)
+    y.sum().backward()
+    # d/dx sum(tanh(2x)) = 2 * (1 - tanh(2x)^2)
+    expect = 2.0 * (1.0 - np.tanh(2.0 * xv) ** 2)
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), expect,
+                               rtol=1e-4, atol=1e-5)
+
+    x2 = paddle.to_tensor(xv.copy(), stop_gradient=False)
+    y2 = x2 + 0.0
+    paddle.reshape_(y2, [4, 3])
+    assert y2.shape == [4, 3]
+    y2.sum().backward()
+    np.testing.assert_allclose(np.asarray(x2.grad.numpy()),
+                               np.ones((3, 4), "float32"))
+
+
+def test_multinomial_without_replacement_unique():
+    import numpy as np
+
+    probs = paddle.to_tensor(
+        np.array([[0.9, 0.04, 0.03, 0.02, 0.01]] * 8, "float32"))
+    draws = np.asarray(paddle.multinomial(
+        probs, num_samples=5, replacement=False).numpy())
+    assert draws.shape == (8, 5)
+    for row in draws:
+        assert len(set(row.tolist())) == 5, row  # a permutation, no dups
+
+
+def test_crop_negative_shape_semantics():
+    import numpy as np
+
+    x = paddle.to_tensor(np.arange(20, dtype="float32").reshape(4, 5))
+    out = paddle.crop(x, shape=[-1, 3], offsets=[1, 0])
+    assert out.shape == [3, 3]  # rows 1..3, NOT clamped back to row 0
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.arange(20).reshape(4, 5)[1:4, 0:3])
