@@ -41,7 +41,11 @@ class Tensor:
         if not isinstance(data, jax.Array):
             arr = np.asarray(data)
             if arr.dtype == np.float64 and dtype is None:
-                arr = arr.astype(np.float32)
+                # python float literals land on the configurable default
+                # float dtype (paddle.set_default_dtype), not raw float64
+                from ..framework.dtype import get_default_dtype, to_numpy_dtype
+
+                arr = arr.astype(to_numpy_dtype(get_default_dtype()))
             data = jnp.asarray(arr)
         if dtype is not None:
             data = data.astype(to_jax_dtype(convert_dtype(dtype)))

@@ -15,3 +15,6 @@ from .layer import *  # noqa: F401,F403
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from . import quant  # noqa: F401
+from .utils import spectral_norm  # noqa: F401

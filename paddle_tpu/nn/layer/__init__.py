@@ -25,3 +25,15 @@ from .transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
+from .rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    SimpleRNN, LSTM, GRU,
+)
+from .extras import (  # noqa: F401
+    Silu, AlphaDropout, Dropout3D, Pad1D, Pad3D, PairwiseDistance,
+    PixelShuffle, Unfold, SpectralNorm, LayerDict, MaxPool1D, AvgPool1D,
+    MaxPool3D, AvgPool3D, AdaptiveAvgPool1D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool3D, Conv3D, Conv3DTranspose,
+    Conv1DTranspose, CTCLoss, HSigmoidLoss, BeamSearchDecoder,
+    dynamic_decode,
+)

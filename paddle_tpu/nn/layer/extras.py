@@ -1,0 +1,507 @@
+"""Surface-completeness layers (reference paddle.nn parity batch):
+activation/dropout/pad/pool/conv variants, PixelShuffle, Unfold,
+SpectralNorm, PairwiseDistance, LayerDict, CTC/HSigmoid losses, and the
+RNN-oriented BeamSearchDecoder + dynamic_decode.
+
+Each class is a thin stateful shell over ``paddle.nn.functional`` (same
+layering as the reference's nn/layer/*.py over nn/functional).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..layer_base import Layer
+from ... import tensor_api as T
+
+__all__ = [
+    "Silu", "AlphaDropout", "Dropout3D", "Pad1D", "Pad3D",
+    "PairwiseDistance", "PixelShuffle", "Unfold", "SpectralNorm",
+    "LayerDict", "MaxPool3D", "AvgPool3D", "MaxPool1D", "AvgPool1D",
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "Conv3D", "Conv3DTranspose", "Conv1DTranspose",
+    "CTCLoss", "HSigmoidLoss", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class Silu(Layer):
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.silu(x)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.dropout3d(x, self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class _PadND(Layer):
+    SPATIAL = 1
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None, name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self.SPATIAL)
+        self.padding = list(padding)
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class Pad1D(_PadND):
+    SPATIAL = 1
+
+
+class Pad3D(_PadND):
+    SPATIAL = 3
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        diff = T.add(T.subtract(x, y),
+                     T.full_like(x, self.epsilon))
+        return T.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class SpectralNorm(Layer):
+    """Parity: spectral_norm_op.cc — power-iteration estimate of the top
+    singular value; returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        import paddle_tpu as paddle
+
+        self.weight_u = self.create_parameter([h])
+        self.weight_u.stop_gradient = True
+        self.weight_u.set_value(
+            np.random.RandomState(0).randn(h).astype("float32"))
+        self.weight_v = self.create_parameter([w])
+        self.weight_v.stop_gradient = True
+        self.weight_v.set_value(
+            np.random.RandomState(1).randn(w).astype("float32"))
+
+    def forward(self, weight):
+        from ...dygraph import tracer
+
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fn(w, u, v):
+            import jax.numpy as jnp
+
+            perm = [dim] + [i for i in range(w.ndim) if i != dim]
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma
+
+        return tracer.trace_fn(fn, [weight, self.weight_u, self.weight_v],
+                               name="spectral_norm")
+
+
+class LayerDict(Layer):
+    """Parity: paddle.nn.LayerDict — dict-like sublayer container."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, sublayer):
+        self.add_sublayer(key, sublayer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        v = self._sub_layers[key]
+        del self._sub_layers[key]
+        return v
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for k, v in items:
+            self.add_sublayer(k, v)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        k, s, p, cm = self._a
+        return F.max_pool1d(x, k, s, p, ceil_mode=cm)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, exclusive, ceil_mode)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        k, s, p, ex, cm = self._a
+        return F.avg_pool1d(x, k, s, p, exclusive=ex, ceil_mode=cm)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        k, s, p, cm = self._a
+        return F.max_pool3d(x, k, s, p, ceil_mode=cm)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, ceil_mode, exclusive)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        k, s, p, cm, ex = self._a
+        return F.avg_pool3d(x, k, s, p, ceil_mode=cm, exclusive=ex)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class _ConvNd(Layer):
+    SPATIAL = 3
+    TRANSPOSE = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        n = self.SPATIAL
+        ks = [kernel_size] * n if isinstance(kernel_size, int) else list(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = dilation
+        self._groups = groups
+        if self.TRANSPOSE:
+            wshape = [in_channels, out_channels // groups] + ks
+        else:
+            wshape = [out_channels, in_channels // groups] + ks
+        self.weight = self.create_parameter(shape=wshape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True))
+
+
+class Conv3D(_ConvNd):
+    SPATIAL = 3
+    TRANSPOSE = False
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv3DTranspose(_ConvNd):
+    SPATIAL = 3
+    TRANSPOSE = True
+
+    def forward(self, x, output_size=None):
+        from .. import functional as F
+
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._dilation, self._groups,
+            output_size=output_size)
+
+
+class Conv1DTranspose(_ConvNd):
+    SPATIAL = 1
+    TRANSPOSE = True
+
+    def forward(self, x, output_size=None):
+        from .. import functional as F
+
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._dilation, self._groups,
+            output_size=output_size)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        from .. import functional as F
+
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree hsigmoid is not wired; default complete "
+                "binary tree is")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label):
+        from .. import functional as F
+
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference
+    ``paddle.nn.BeamSearchDecoder``), driven by :func:`dynamic_decode`.
+
+    Works on the eager path with numpy-side control flow (the reference's
+    decoder is likewise host-driven); for the transformer flagship the
+    fused in-scan beam search lives in ``models/generation.py``.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Parity: paddle.nn.dynamic_decode — run the decoder to EOS/max steps.
+    Returns (ids [B, T], final_scores [B]) for the best beam."""
+    import jax
+    import jax.numpy as jnp
+
+    cell = decoder.cell
+    k = decoder.beam_size
+    emb = decoder.embedding_fn
+    outf = decoder.output_fn
+
+    def np_of(t):
+        return np.asarray(t._array if hasattr(t, "_array") else t)
+
+    # infer batch from inits
+    if inits is None:
+        raise ValueError("dynamic_decode needs initial states (inits)")
+    flat0 = inits[0] if isinstance(inits, (tuple, list)) else inits
+    b = flat0.shape[0]
+
+    def tile(s):
+        if isinstance(s, (tuple, list)):
+            return type(s)(tile(v) for v in s)
+        arr = np_of(s)
+        return T.to_tensor(np.repeat(arr, k, axis=0))
+
+    states = tile(inits)
+    tokens = np.full((b * k,), decoder.start_token, "int64")
+    scores = np.zeros((b, k), "float32")
+    scores[:, 1:] = -1e9  # all beams start identical: keep one live
+    finished = np.zeros((b * k,), bool)
+    collected = []
+    for step in range(max_step_num):
+        tok_t = T.to_tensor(tokens)
+        inp = emb(tok_t) if emb is not None else T.cast(
+            T.unsqueeze(tok_t, [-1]), "float32")
+        out, new_states = cell(inp, states)
+        logits = outf(out) if outf is not None else out
+        lp = np.array(jax.nn.log_softmax(
+            jnp.asarray(np_of(logits), jnp.float32), axis=-1))
+        v = lp.shape[-1]
+        lp[finished] = -1e9
+        lp[finished, decoder.end_token] = 0.0
+        cand = (scores.reshape(-1, 1) + lp).reshape(b, k * v)
+        top = np.argsort(-cand, axis=1)[:, :k]
+        scores = np.take_along_axis(cand, top, axis=1).astype("float32")
+        parent = top // v
+        tokens = (top % v).reshape(-1).astype("int64")
+        rows = (np.arange(b)[:, None] * k + parent).reshape(-1)
+
+        def reorder(s):
+            if isinstance(s, (tuple, list)):
+                return type(s)(reorder(x) for x in s)
+            return T.to_tensor(np_of(s)[rows])
+
+        states = reorder(new_states)
+        finished = finished[rows] | (tokens == decoder.end_token)
+        collected.append((tokens.copy(), rows.copy()))
+        if finished.all():
+            break
+
+    # backtrack best beam
+    t_total = len(collected)
+    best = scores.argmax(axis=1)
+    rows = np.arange(b) * k + best
+    seq = np.zeros((b, t_total), "int64")
+    for t in range(t_total - 1, -1, -1):
+        toks, parents = collected[t]
+        seq[:, t] = toks[rows]
+        rows = parents[rows]
+    return (T.to_tensor(seq),
+            T.to_tensor(np.take_along_axis(scores, best[:, None],
+                                           axis=1)[:, 0]))

@@ -1,0 +1,346 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU cells and stacks.
+
+Parity: ``/root/reference/python/paddle/nn/layer/rnn.py`` (RNNCellBase:
+get_initial_states, SimpleRNNCell:258, LSTMCell:390, GRUCell:543, RNN,
+BiRNN, and the multi-layer SimpleRNN/LSTM/GRU over the same gate algebra —
+LSTM gate order i,f,c,o; GRU reset-after-matmul: ``c = tanh(x_c + r*h_c)``,
+``h = (pre_h - c) * z + c``).
+
+TPU note: the time loop is a traced Python loop — under ``jit``/
+``to_static`` XLA unrolls and pipelines it, which beats the reference's
+per-step dynamic dispatch; the flagship long-sequence path remains the
+transformer stack (flash/ring attention), matching the reference's own
+positioning of RNNs as a non-headline workload (cudnn_lstm exists but the
+BASELINE configs never use it).  Masked ``sequence_length`` semantics:
+outputs past a row's length are zeros and its final state freezes at the
+last valid step (reference ``mask_fn`` behavior).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..layer_base import Layer, LayerList
+from ..initializer import Uniform
+from ... import tensor_api as T
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        shapes = shape if isinstance(shape, tuple) and shape and \
+            isinstance(shape[0], tuple) else (shape,)
+        outs = tuple(T.full([b] + list(s), init_value, dtype) for s in shapes)
+        return outs if len(outs) > 1 else outs[0]
+
+
+def _uniform_attr(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    from .. import ParamAttr
+
+    return ParamAttr(initializer=Uniform(-std, std))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"activation must be tanh or relu: {activation}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        ua = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr or ua)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr or ua)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr or ua, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr or ua, is_bias=True)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        act = T.tanh if self.activation == "tanh" else (
+            lambda v: T.maximum(v, T.zeros_like(v)))
+        h = act(T.matmul(inputs, self.weight_ih, transpose_y=True)
+                + self.bias_ih
+                + T.matmul(states, self.weight_hh, transpose_y=True)
+                + self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        ua = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr or ua)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr or ua)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr or ua, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr or ua, is_bias=True)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h, pre_c = states
+        gates = (T.matmul(inputs, self.weight_ih, transpose_y=True)
+                 + self.bias_ih
+                 + T.matmul(pre_h, self.weight_hh, transpose_y=True)
+                 + self.bias_hh)
+        i, f, g, o = T.split(gates, 4, axis=-1)
+        i, f, o = F_sigmoid(i), F_sigmoid(f), F_sigmoid(o)
+        c = f * pre_c + i * T.tanh(g)
+        h = o * T.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        ua = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr or ua)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr or ua)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr or ua, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr or ua, is_bias=True)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h = states
+        xg = T.matmul(inputs, self.weight_ih, transpose_y=True) + self.bias_ih
+        hg = T.matmul(pre_h, self.weight_hh, transpose_y=True) + self.bias_hh
+        x_r, x_z, x_c = T.split(xg, 3, axis=-1)
+        h_r, h_z, h_c = T.split(hg, 3, axis=-1)
+        r = F_sigmoid(x_r + h_r)
+        z = F_sigmoid(x_z + h_z)
+        c = T.tanh(x_c + r * h_c)  # reset applied after the matmul
+        h = (pre_h - c) * z + c
+        return h, h
+
+
+def F_sigmoid(x):
+    from .. import functional as F
+
+    return F.sigmoid(x)
+
+
+def _mask_step(new, old, valid):
+    """valid: [b, 1] float mask — keep ``new`` where valid else ``old``."""
+    return new * valid + old * (1.0 - valid)
+
+
+def _tree_map2(fn, a, b):
+    if isinstance(a, (tuple, list)):
+        return type(a)(_tree_map2(fn, x, y) for x, y in zip(a, b))
+    return fn(a, b)
+
+
+class RNN(Layer):
+    """Run a cell over the time dim (reference RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if not self.time_major:
+            x = inputs
+            time_axis = 1
+        else:
+            x = inputs
+            time_axis = 0
+        steps = x.shape[time_axis]
+        states = initial_states
+        if states is None:
+            batch_ref = inputs if not self.time_major else T.transpose(
+                inputs, [1, 0, 2])
+            states = self.cell.get_initial_states(
+                batch_ref, self.cell.state_shape)
+        seq_mask = None
+        if sequence_length is not None:
+            seq_mask = T.cast(sequence_length, "float32")
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = [None] * steps
+        for t in order:
+            xt = (T.squeeze(T.slice(x, [time_axis], [t], [t + 1]),
+                            [time_axis]))
+            out, new_states = self.cell(xt, states)
+            if seq_mask is not None:
+                valid = T.cast(
+                    T.less_than(T.full_like(seq_mask, float(t)), seq_mask),
+                    "float32")
+                valid = T.unsqueeze(valid, [-1])
+                out = out * valid
+                states = _tree_map2(
+                    lambda n, o: _mask_step(n, o, valid), new_states, states)
+            else:
+                states = new_states
+            outs[t] = out
+        outputs = T.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        of, fs = self.rnn_fw(inputs, sf, sequence_length)
+        ob, bs = self.rnn_bw(inputs, sb, sequence_length)
+        outputs = T.concat([of, ob], axis=-1)
+        return outputs, (fs, bs)
+
+
+class _RNNBase(Layer):
+    """Stacked (and optionally bidirectional) recurrent network."""
+
+    CELL = None
+    STATE_TUPLE = False
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"direction: {direction}")
+        self.bidirectional = direction != "forward"
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        kw = dict(weight_ih_attr=weight_ih_attr,
+                  weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        if activation is not None:
+            kw["activation"] = activation
+        num_dirs = 2 if self.bidirectional else 1
+        layers = []
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else hidden_size * num_dirs
+            cell_fw = type(self).CELL(in_sz, hidden_size, **kw)
+            if self.bidirectional:
+                cell_bw = type(self).CELL(in_sz, hidden_size, **kw)
+                layers.append(BiRNN(cell_fw, cell_bw, time_major=time_major))
+            else:
+                layers.append(RNN(cell_fw, time_major=time_major))
+        self._stack = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+
+        x = inputs
+        finals = []
+        for li, layer in enumerate(self._stack):
+            init = None
+            if initial_states is not None:
+                init = self._layer_init(initial_states, li)
+            x, st = layer(x, init, sequence_length)
+            finals.append(st)
+            if self.dropout and li < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+        return x, self._pack_finals(finals)
+
+    def _layer_init(self, initial_states, li):
+        """initial_states: (h[, c]) with leading dim num_layers*num_dirs."""
+        nd = 2 if self.bidirectional else 1
+
+        def pick(s, idx):
+            return T.squeeze(T.slice(s, [0], [idx], [idx + 1]), [0])
+
+        if type(self).STATE_TUPLE:
+            h0, c0 = initial_states
+            if nd == 2:
+                return ((pick(h0, 2 * li), pick(c0, 2 * li)),
+                        (pick(h0, 2 * li + 1), pick(c0, 2 * li + 1)))
+            return (pick(h0, li), pick(c0, li))
+        h0 = initial_states
+        if nd == 2:
+            return (pick(h0, 2 * li), pick(h0, 2 * li + 1))
+        return pick(h0, li)
+
+    def _pack_finals(self, finals):
+        """Stack per-layer(-direction) final states into the reference's
+        [num_layers*num_dirs, b, h] layout."""
+        hs, cs = [], []
+        for st in finals:
+            dirs = st if self.bidirectional else (st,)
+            for d in dirs:
+                if type(self).STATE_TUPLE:
+                    hs.append(d[0])
+                    cs.append(d[1])
+                else:
+                    hs.append(d)
+        h = T.stack(hs, axis=0)
+        if type(self).STATE_TUPLE:
+            return (h, T.stack(cs, axis=0))
+        return h
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+    STATE_TUPLE = False
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+    STATE_TUPLE = True
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell
+    STATE_TUPLE = False

@@ -1,0 +1,45 @@
+"""``paddle.nn.quant`` — quantization layer surface.
+
+Parity: the reference's ``python/paddle/nn/quant/`` (FloatFunctionalLayer
+wrappers routing binary ops through quantizable layers).  The substantive
+quantization machinery (QAT + PTQ wrappers, fake-quant kernels) lives in
+``paddle_tpu.incubate.quant``; this namespace re-exports it plus the
+functional-layer shims.
+"""
+
+from ...incubate.quant import (  # noqa: F401
+    ImperativePTQ, ImperativeQuantAware, QuantizedConv2D, QuantizedLinear,
+)
+from ..layer_base import Layer
+from ... import tensor_api as T
+
+__all__ = ["FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
+           "ImperativeQuantAware", "ImperativePTQ", "QuantizedLinear",
+           "QuantizedConv2D"]
+
+
+class FloatFunctionalLayer(Layer):
+    """Binary ops as layers so QAT can wrap them (nn/quant/functional_layers.py)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x, y):
+        return self._fn(x, y)
+
+
+def add():
+    return FloatFunctionalLayer(T.add)
+
+
+def subtract():
+    return FloatFunctionalLayer(T.subtract)
+
+
+def multiply():
+    return FloatFunctionalLayer(T.multiply)
+
+
+def divide():
+    return FloatFunctionalLayer(T.divide)

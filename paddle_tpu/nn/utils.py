@@ -1,0 +1,114 @@
+"""``paddle.nn.utils`` — weight_norm / spectral_norm / remove_weight_norm.
+
+Parity: ``/root/reference/python/paddle/nn/utils/`` (weight_norm_hook.py,
+spectral_norm_hook.py): reparameterize a layer's weight as
+``g * v / ||v||`` (weight norm) or ``w / sigma_max`` (spectral norm,
+power iteration) recomputed each forward through a pre-hook.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor_api as T
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_over(w, dim):
+    from ..dygraph import tracer
+
+    def fn(a):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return jnp.sqrt(jnp.sum(jnp.square(a))).reshape(1)
+        perm = [dim] + [i for i in range(a.ndim) if i != dim]
+        mat = jnp.transpose(a, perm).reshape(a.shape[dim], -1)
+        return jnp.sqrt(jnp.sum(jnp.square(mat), axis=1))
+
+    return tracer.trace_fn(fn, [w], name="wn_norm")
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Split ``layer.weight`` into direction ``weight_v`` and magnitude
+    ``weight_g``; recompose on every forward via a pre-hook."""
+    w = getattr(layer, name)
+    g0 = _norm_over(w, dim)
+    v = layer.create_parameter(shape=list(w.shape))
+    v.set_value(np.asarray(w.numpy()))
+    g = layer.create_parameter(shape=list(g0.shape))
+    g.set_value(np.asarray(g0.numpy()))
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+    # the original weight becomes derived state, not a parameter
+    del layer._parameters[name]
+
+    def recompute(lyr, inputs):
+        from ..dygraph import tracer
+
+        def fn(vv, gg):
+            import jax.numpy as jnp
+
+            if dim is None:
+                nrm = jnp.sqrt(jnp.sum(jnp.square(vv)))
+                return vv * (gg.reshape(()) / nrm)
+            perm = [dim] + [i for i in range(vv.ndim) if i != dim]
+            inv = np.argsort(perm)
+            mat = jnp.transpose(vv, perm)
+            nrm = jnp.sqrt(jnp.sum(
+                jnp.square(mat.reshape(mat.shape[0], -1)), axis=1))
+            scaled = mat * (gg / nrm).reshape(
+                (-1,) + (1,) * (vv.ndim - 1))
+            return jnp.transpose(scaled, list(inv))
+
+        new_w = tracer.trace_fn(fn, [lyr.weight_v if name == "weight"
+                                     else getattr(lyr, name + "_v"),
+                                     getattr(lyr, name + "_g")],
+                                name="weight_norm")
+        object.__setattr__(lyr, name, new_w)
+        return None
+
+    h = layer.register_forward_pre_hook(recompute)
+    layer._weight_norm_hook = (h, name, dim)
+    recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    h, nm, dim = layer._weight_norm_hook
+    h.remove() if hasattr(h, "remove") else None
+    w = getattr(layer, nm)
+    p = layer.create_parameter(shape=list(w.shape))
+    p.set_value(np.asarray(w.numpy()))
+    layer._parameters[nm] = p
+    object.__setattr__(layer, nm, p)
+    for suffix in ("_v", "_g"):
+        layer._parameters.pop(nm + suffix, None)
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Normalize ``layer.weight`` by its top singular value each forward."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    from .layer.extras import SpectralNorm as _SN
+
+    sn = _SN(list(w.shape), dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer._spectral_norm = sn
+    raw = layer.create_parameter(shape=list(w.shape))
+    raw.set_value(np.asarray(w.numpy()))
+    setattr(layer, name + "_orig", raw)
+    del layer._parameters[name]
+
+    def recompute(lyr, inputs):
+        object.__setattr__(lyr, name,
+                           sn(getattr(lyr, name + "_orig")))
+        return None
+
+    layer.register_forward_pre_hook(recompute)
+    recompute(layer, None)
+    return layer

@@ -315,7 +315,8 @@ register_op("lgamma")(_unary(jax.lax.lgamma))
 register_op("digamma")(_unary(jax.lax.digamma))
 register_op("trunc", no_grad=True)(_unary(jnp.trunc))
 register_op("conj")(_unary(jnp.conj))
-register_op("real", no_grad=True)(_unary(jnp.real))
+# real is differentiable (identity for real dtypes — reference real_grad)
+register_op("real")(_unary(jnp.real))
 register_op("imag", no_grad=True)(_unary(jnp.imag))
 register_op("atan2")(_binary(jnp.arctan2))
 

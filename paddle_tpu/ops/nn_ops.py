@@ -105,7 +105,11 @@ def conv2d_transpose_kernel(ins, attrs):
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
     out = jax.lax.conv_general_dilated(
         x,
-        w,
+        # the transposed conv is the ADJOINT of the forward conv: besides
+        # swapping I/O (the IOHW spec), the kernel must be spatially
+        # flipped — without the flip this computes a correlation with the
+        # unflipped kernel, which differs for any non-symmetric kernel
+        jnp.flip(w, axis=(-2, -1)),
         window_strides=(1, 1),
         padding=adj_pad,
         lhs_dilation=strides,
@@ -626,3 +630,102 @@ def norm_kernel(ins, attrs):
     eps = attrs.get("epsilon", 1e-10)
     n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
     return {"Out": x / n, "Norm": n}
+
+
+@register_op("conv3d")
+def conv3d_kernel(ins, attrs):
+    """Parity: conv3d_op.cc — NCDHW via lax.conv_general_dilated (the MXU
+    path generalizes over spatial rank)."""
+    x, w = ins["Input"], ins["Filter"]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = attrs.get("groups", 1)
+    p = attrs.get("paddings", [0, 0, 0])
+    if len(p) == 3:
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    else:
+        pad = [(p[0], p[1]), (p[2], p[3]), (p[4], p[5])]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+    )
+    return {"Output": out.astype(x.dtype)}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose_kernel(ins, attrs):
+    """Parity: conv3d_transpose_op.cc (lhs-dilated conv form)."""
+    x, w = ins["Input"], ins["Filter"]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    dilations = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = attrs.get("groups", 1)
+    p = attrs.get("paddings", [0, 0, 0])
+    if len(p) == 3:
+        pad = [(p[i], p[i]) for i in range(3)]
+    else:
+        pad = [(p[0], p[1]), (p[2], p[3]), (p[4], p[5])]
+    out_pad = attrs.get("output_padding", [0, 0, 0]) or [0, 0, 0]
+    if isinstance(out_pad, int):
+        out_pad = [out_pad] * 3
+    ks = w.shape[-3:]
+    adj = [(dilations[i] * (k - 1) - pad[i][0],
+            dilations[i] * (k - 1) - pad[i][1] + out_pad[i])
+           for i, k in enumerate(ks)]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCDHW", "IODHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, axis=(-3, -2, -1)),  # adjoint needs the spatial flip
+        window_strides=(1, 1, 1), padding=adj,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    return {"Output": out.astype(x.dtype)}
+
+
+@register_op("pool3d")
+def pool3d_kernel(ins, attrs):
+    """Parity: pool_op.cc 3-D variant (max/avg, global, adaptive)."""
+    import numpy as np
+
+    x = ins["X"]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [1, 1, 1]))
+    strides = tuple(attrs.get("strides", ksize))
+    p = attrs.get("paddings", [0, 0, 0])
+    adaptive = attrs.get("adaptive", False)
+    if attrs.get("global_pooling", False) or (
+            adaptive and tuple(ksize) == (1, 1, 1)):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(x, axis=(2, 3, 4), keepdims=True)}
+    if adaptive:
+        od, oh, ow = ksize
+        d, h, w = x.shape[2:]
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive pool3d requires divisible sizes"
+        x7 = x.reshape(x.shape[0], x.shape[1], od, d // od, oh, h // oh,
+                       ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(x7, axis=(3, 5, 7))}
+    if len(p) == 3:
+        pad = [(0, 0), (0, 0)] + [(p[i], p[i]) for i in range(3)]
+    else:
+        pad = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]), (p[4], p[5])]
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    if ptype == "max":
+        init = (np.array(-np.inf, x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else np.iinfo(x.dtype).min)
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides5,
+                                    pad)
+        return {"Out": out}
+    s = jax.lax.reduce_window(x, np.array(0.0, x.dtype), jax.lax.add,
+                              window, strides5, pad)
+    ones = jnp.ones_like(x)
+    cnt = jax.lax.reduce_window(ones, np.array(0.0, x.dtype), jax.lax.add,
+                                window, strides5, pad)
+    if not attrs.get("exclusive", True):
+        cnt = jnp.full_like(cnt, float(np.prod(ksize)))
+    return {"Out": s / cnt}

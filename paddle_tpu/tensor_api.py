@@ -104,15 +104,19 @@ def _wrap(v, like=None):
 # ---------------------------------------------------------------------------
 
 
-def zeros(shape, dtype="float32", name=None):
+def zeros(shape, dtype=None, name=None):
     return full(shape, 0.0, dtype)
 
 
-def ones(shape, dtype="float32", name=None):
+def ones(shape, dtype=None, name=None):
     return full(shape, 1.0, dtype)
 
 
-def full(shape, fill_value, dtype="float32", name=None):
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        from .framework.dtype import get_default_dtype
+
+        dtype = get_default_dtype()
     if is_tensor(shape):
         shape = [int(s) for s in np.asarray(shape.numpy())]
     shape = [int(s) for s in (shape if isinstance(shape, (list, tuple)) else [shape])]
@@ -141,7 +145,7 @@ def full_like(x, fill_value, dtype=None, name=None):
     )
 
 
-def empty(shape, dtype="float32", name=None):
+def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype)
 
 
@@ -185,11 +189,15 @@ def eye(num_rows, num_columns=None, dtype="float32", name=None):
     )
 
 
-def rand(shape, dtype="float32", name=None):
+def rand(shape, dtype=None, name=None):
     return uniform(shape, dtype, min=0.0, max=1.0)
 
 
-def randn(shape, dtype="float32", name=None):
+def randn(shape, dtype=None, name=None):
+    if dtype is None:
+        from .framework.dtype import get_default_dtype
+
+        dtype = get_default_dtype()
     return _d(
         "gaussian_random",
         {},
@@ -211,7 +219,11 @@ def randperm(n, dtype="int64", name=None):
     return _d("randperm", {}, {"n": n, "dtype": convert_dtype(dtype)})
 
 
-def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    if dtype is None:
+        from .framework.dtype import get_default_dtype
+
+        dtype = get_default_dtype()
     return _d(
         "uniform_random",
         {},

@@ -1,0 +1,311 @@
+"""Surface-completeness nn layers/functionals (extras.py + functional batch)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_conv3d_transpose_adjoint():
+    """<conv3d(x), y> == <x, conv3d_transpose(y)> with shared weights —
+    the defining property of the transposed convolution."""
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(1, 2, 4, 4, 4).astype("float32"))
+    w = paddle.to_tensor(rs.randn(3, 2, 2, 2, 2).astype("float32"))
+    y_shape = _np(F.conv3d(x, w, stride=2)).shape
+    y = paddle.to_tensor(rs.randn(*y_shape).astype("float32"))
+    lhs = float(np.sum(_np(F.conv3d(x, w, stride=2)) * _np(y)))
+    # transpose takes weight in (in, out, k, k, k) layout = same tensor
+    xt = F.conv3d_transpose(y, w, stride=2)
+    assert _np(xt).shape == tuple(x.shape)
+    rhs = float(np.sum(_np(x) * _np(xt)))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+
+def test_pool1d_and_adaptive():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 2, 8))
+    out = F.max_pool1d(x, 2, stride=2)
+    np.testing.assert_allclose(
+        _np(out), np.arange(16, dtype="float32").reshape(1, 2, 4, 2).max(-1))
+    ada = F.adaptive_avg_pool1d(x, 4)
+    np.testing.assert_allclose(
+        _np(ada),
+        np.arange(16, dtype="float32").reshape(1, 2, 4, 2).mean(-1))
+    layer = nn.AdaptiveAvgPool1D(4)
+    np.testing.assert_allclose(_np(layer(x)), _np(ada))
+
+
+def test_pixel_shuffle_matches_numpy():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 8, 3, 3).astype("float32")
+    out = _np(F.pixel_shuffle(paddle.to_tensor(x), 2))
+    ref = x.reshape(2, 2, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(2, 2, 6, 6)
+    np.testing.assert_allclose(out, ref)
+    assert _np(nn.PixelShuffle(2)(paddle.to_tensor(x))).shape == (2, 2, 6, 6)
+
+
+def test_glu_and_diag_embed():
+    rs = np.random.RandomState(2)
+    x = rs.randn(3, 8).astype("float32")
+    out = _np(F.glu(paddle.to_tensor(x)))
+    a, b = x[:, :4], x[:, 4:]
+    np.testing.assert_allclose(out, a / (1 + np.exp(-b)), rtol=1e-5)
+    v = rs.randn(2, 3).astype("float32")
+    d = _np(F.diag_embed(paddle.to_tensor(v)))
+    assert d.shape == (2, 3, 3)
+    for i in range(2):
+        np.testing.assert_allclose(d[i], np.diag(v[i]))
+
+
+def test_grid_sample_identity_and_affine():
+    rs = np.random.RandomState(3)
+    x = rs.randn(1, 2, 5, 7).astype("float32")
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], "float32")  # identity
+    grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 5, 7])
+    out = _np(F.grid_sample(paddle.to_tensor(x), grid))
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+    # pure translation off the edge zero-pads
+    theta2 = np.array([[[1, 0, 2.5], [0, 1, 0]]], "float32")
+    g2 = F.affine_grid(paddle.to_tensor(theta2), [1, 2, 5, 7])
+    out2 = _np(F.grid_sample(paddle.to_tensor(x), g2))
+    assert np.abs(out2[..., -1]).max() == 0.0
+
+
+def test_ctc_loss_matches_bruteforce():
+    """Tiny case: T=3, one label — enumerate all alignments."""
+    rs = np.random.RandomState(4)
+    logits = rs.randn(3, 1, 4).astype("float32")  # T, B, C
+    labels = np.array([[2]], "int64")
+    loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([3], "int64")),
+                      paddle.to_tensor(np.array([1], "int64")),
+                      blank=0, reduction="none")
+    lp = logits[:, 0, :].astype("float64")
+    lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+    # valid alignments of label [2] over 3 frames (blank=0); note
+    # (2, 0, 2) decodes to [2, 2], so it is NOT included
+    paths = [(2, 0, 0), (0, 2, 0), (0, 0, 2), (2, 2, 0), (0, 2, 2),
+             (2, 2, 2)]
+    tot = -np.inf
+    for p in paths:
+        s = sum(lp[t, c] for t, c in enumerate(p))
+        tot = np.logaddexp(tot, s)
+    np.testing.assert_allclose(float(_np(loss)[0]), -tot, rtol=1e-4)
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], "int64")      # T,B,K
+    parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], "int64")
+    out = _np(F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(parents)))
+    # beam 0 backtrack: t2 token 4 (parent 1) -> t1 token 6 (parent 0)
+    # -> t0 token 2; beam 1: t2 token 7 (parent 0) -> t1 token 3
+    # (parent 1) -> t0 token 5
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 4])
+    np.testing.assert_array_equal(out[:, 0, 1], [5, 3, 7])
+
+
+def test_losses_numeric():
+    rs = np.random.RandomState(5)
+    p = paddle.to_tensor(rs.uniform(0.1, 0.9, (4, 1)).astype("float32"))
+    y = paddle.to_tensor((rs.rand(4, 1) > 0.5).astype("float32"))
+    ll = _np(F.log_loss(p, y))
+    pn, yn = _np(p), _np(y)
+    ref = -yn * np.log(pn + 1e-4) - (1 - yn) * np.log(1 - pn + 1e-4)
+    np.testing.assert_allclose(ll, ref, rtol=1e-4)
+
+    logit = paddle.to_tensor(rs.randn(6, 3).astype("float32"))
+    lab = paddle.to_tensor((rs.rand(6, 3) > 0.7).astype("float32"))
+    fl = float(_np(F.sigmoid_focal_loss(logit, lab, reduction="sum")))
+    pr = 1 / (1 + np.exp(-_np(logit)))
+    ce = -(_np(lab) * np.log(pr) + (1 - _np(lab)) * np.log(1 - pr))
+    p_t = pr * _np(lab) + (1 - pr) * (1 - _np(lab))
+    a_t = 0.25 * _np(lab) + 0.75 * (1 - _np(lab))
+    ref_fl = (a_t * ce * (1 - p_t) ** 2).sum()
+    np.testing.assert_allclose(fl, ref_fl, rtol=1e-4)
+
+
+def test_local_response_norm_and_temporal_shift():
+    rs = np.random.RandomState(6)
+    x = rs.randn(2, 6, 4, 4).astype("float32")
+    out = _np(F.local_response_norm(paddle.to_tensor(x), size=3))
+    sq = np.pad(x ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    den = sum(sq[:, i:i + 6] for i in range(3))
+    np.testing.assert_allclose(out, x / (1.0 + 1e-4 * den) ** 0.75,
+                               rtol=1e-4)
+    ts = _np(F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                              shift_ratio=0.25))
+    assert ts.shape == x.shape
+    xs = x.reshape(1, 2, 6, 4, 4)
+    np.testing.assert_allclose(ts.reshape(1, 2, 6, 4, 4)[0, 0, 0],
+                               xs[0, 1, 0])  # ch 0 shifted forward
+
+
+def test_spectral_and_weight_norm():
+    paddle.seed(7)
+    lin = nn.Linear(6, 4)
+    w0 = _np(lin.weight).copy()
+    nn.utils.weight_norm(lin, dim=0)
+    x = paddle.to_tensor(np.random.RandomState(7).randn(2, 6)
+                         .astype("float32"))
+    lin(x)
+    np.testing.assert_allclose(_np(lin.weight), w0, rtol=1e-5, atol=1e-6)
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(_np(lin.weight), w0, rtol=1e-5, atol=1e-6)
+
+    lin2 = nn.Linear(6, 4)
+    nn.utils.spectral_norm(lin2, n_power_iterations=20)
+    lin2(x)
+    s = np.linalg.svd(_np(lin2.weight), compute_uv=False)[0]
+    np.testing.assert_allclose(s, 1.0, rtol=1e-2)
+
+
+def test_hsigmoid_trains():
+    paddle.seed(8)
+    feat, classes = 8, 6
+    layer = nn.HSigmoidLoss(feat, classes)
+    rs = np.random.RandomState(8)
+    x = paddle.to_tensor(rs.randn(16, feat).astype("float32"))
+    y = paddle.to_tensor(rs.randint(0, classes, (16, 1)).astype("int64"))
+    o = opt.Adam(0.05, parameters=layer.parameters())
+    losses = []
+    for _ in range(10):
+        loss = layer(x, y).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_beam_search_decoder_dynamic_decode():
+    paddle.seed(9)
+    vocab, hidden = 12, 8
+    cell = nn.GRUCell(vocab, hidden)
+    emb_w = paddle.to_tensor(
+        np.random.RandomState(9).randn(vocab, vocab).astype("float32"))
+    head = nn.Linear(hidden, vocab)
+
+    def embed(tok):
+        import paddle_tpu.tensor_api as T
+
+        return F.embedding(tok, emb_w)
+
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=3,
+                               embedding_fn=embed, output_fn=head)
+    h0 = paddle.to_tensor(np.zeros((2, hidden), "float32"))
+    ids, scores = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+    assert _np(ids).shape[0] == 2 and _np(ids).shape[1] <= 6
+    assert np.isfinite(_np(scores)).all()
+    # greedy consistency: beam_size=1 equals an argmax rollout
+    dec1 = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                beam_size=1, embedding_fn=embed,
+                                output_fn=head)
+    ids1, _ = nn.dynamic_decode(dec1, inits=h0, max_step_num=6)
+    tok = np.full((2,), 1, "int64")
+    h = h0
+    roll = []
+    done = np.zeros(2, bool)
+    for _ in range(_np(ids1).shape[1]):
+        out, h = cell(embed(paddle.to_tensor(tok)), h)
+        logits = _np(head(out))
+        nxt = logits.argmax(-1)
+        nxt = np.where(done, 2, nxt)
+        roll.append(nxt)
+        done |= nxt == 2
+        tok = nxt.astype("int64")
+        if done.all():
+            break
+    np.testing.assert_array_equal(_np(ids1), np.stack(roll, 1))
+
+
+def test_layer_dict_and_misc_layers():
+    d = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+    assert set(d.keys()) == {"a", "b"} and len(d) == 2
+    assert "a" in d
+    assert len(list(d.parameters())) == 2  # linear w+b
+    d["c"] = nn.Silu()
+    x = paddle.to_tensor(np.random.RandomState(10).randn(2, 2)
+                         .astype("float32"))
+    out = d["c"](d["b"](d["a"](x)))
+    assert out.shape == [2, 3]
+    d.pop("c")
+    assert len(d) == 2
+
+    x5 = paddle.to_tensor(np.random.RandomState(11)
+                          .randn(1, 2, 4, 4, 4).astype("float32"))
+    assert nn.MaxPool3D(2, 2)(x5).shape == [1, 2, 2, 2, 2]
+    assert nn.Dropout3D(0.5)(x5).shape == [1, 2, 4, 4, 4]
+    assert nn.Conv3D(2, 3, 2)(x5).shape == [1, 3, 3, 3, 3]
+    x3 = paddle.to_tensor(np.random.RandomState(12)
+                          .randn(2, 3, 8).astype("float32"))
+    assert nn.Conv1DTranspose(3, 4, 2, stride=2)(x3).shape == [2, 4, 16]
+    pd = nn.PairwiseDistance()
+    a = paddle.to_tensor(np.ones((2, 4), "float32"))
+    b = paddle.to_tensor(np.zeros((2, 4), "float32"))
+    np.testing.assert_allclose(_np(pd(a, b)), [2.0, 2.0], rtol=1e-4)
+
+
+def test_conv_transpose_matches_torch():
+    """Ground truth vs torch (CPU) across stride/padding/output_padding —
+    regression for the missing spatial kernel flip."""
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(20)
+    y2 = rs.randn(2, 4, 5, 5).astype("float32")
+    w2 = rs.randn(4, 3, 3, 3).astype("float32")
+    ours = _np(F.conv2d_transpose(
+        paddle.to_tensor(y2), paddle.to_tensor(w2), stride=2, padding=1,
+        output_padding=1))
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(y2), torch.tensor(w2), stride=2, padding=1,
+        output_padding=1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    y3 = rs.randn(1, 2, 3, 3, 3).astype("float32")
+    w3 = rs.randn(2, 2, 2, 2, 2).astype("float32")
+    ours3 = _np(F.conv3d_transpose(
+        paddle.to_tensor(y3), paddle.to_tensor(w3), stride=2))
+    ref3 = torch.nn.functional.conv_transpose3d(
+        torch.tensor(y3), torch.tensor(w3), stride=2).numpy()
+    np.testing.assert_allclose(ours3, ref3, rtol=1e-4, atol=1e-5)
+
+
+def test_alpha_dropout_preserves_moments():
+    """Non-default p must still be ~zero-mean unit-variance (the formula
+    regression: a used p where 1-p belongs)."""
+    paddle.seed(42)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(200_000).astype("float32"))
+    for p in (0.2, 0.5):
+        out = _np(F.alpha_dropout(x, p=p, training=True))
+        assert abs(out.mean()) < 0.02, (p, out.mean())
+        assert abs(out.std() - 1.0) < 0.03, (p, out.std())
+
+
+def test_layout_guards_raise():
+    x3 = paddle.to_tensor(np.zeros((1, 2, 8), "float32"))
+    w3 = paddle.to_tensor(np.zeros((3, 2, 2), "float32"))
+    with pytest.raises(NotImplementedError, match="data_format"):
+        F.conv1d(x3, w3, data_format="NLC")
+    x5 = paddle.to_tensor(np.zeros((1, 2, 4, 4, 4), "float32"))
+    with pytest.raises(NotImplementedError, match="data_format"):
+        F.max_pool3d(x5, 2, data_format="NDHWC")
+    with pytest.raises(NotImplementedError, match="return_mask"):
+        F.max_pool3d(x5, 2, return_mask=True)
+
+
+def test_real_is_differentiable():
+    x = paddle.to_tensor(np.ones((2, 2), "float32"), stop_gradient=False)
+    y = paddle.real(x * 3.0)
+    y.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), 3.0 * np.ones((2, 2)))

@@ -270,6 +270,57 @@ case("crop_tensor", inputs={"X": _cr_x},
      attrs={"offsets": [1, 2], "shape": [2, 3]},
      refs={"Out": _cr_x[1:3, 2:5].copy()}, grad=("X",))
 
+def _np_conv3d(x, w, stride=1, pad=0):
+    import itertools
+    n, ci, d, h, ww = x.shape
+    co, _, kd, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad), (pad, pad)))
+    od = (xp.shape[2] - kd) // stride + 1
+    oh = (xp.shape[3] - kh) // stride + 1
+    ow = (xp.shape[4] - kw) // stride + 1
+    out = np.zeros((n, co, od, oh, ow))
+    for z, i, j in itertools.product(range(od), range(oh), range(ow)):
+        patch = xp[:, :, z*stride:z*stride+kd, i*stride:i*stride+kh,
+                   j*stride:j*stride+kw]
+        out[:, :, z, i, j] = np.einsum("ncdhw,ocdhw->no", patch, w)
+    return out
+
+
+_c3x = R(81).randn(2, 3, 4, 5, 5).astype("float32")
+_c3w = R(82).randn(4, 3, 2, 3, 3).astype("float32")
+case("conv3d",
+     inputs={"Input": _c3x, "Filter": _c3w},
+     attrs={"strides": [1, 1, 1], "paddings": [1, 1, 1],
+            "dilations": [1, 1, 1], "groups": 1},
+     refs={"Output": _np_conv3d(_c3x.astype("float64"),
+                                _c3w.astype("float64"),
+                                pad=1).astype("float32")},
+     out="Output", grad=("Input", "Filter"), gatol=2e-2, grtol=2e-2)
+
+# conv3d_transpose: verified by the adjoint identity <conv(x), y> ==
+# <x, conv_T(y)> in tests/test_nn_extras.py (no simple closed-form numpy
+# reference at this size) — here: shape + FD-grad only
+_ct_x = R(83).randn(1, 2, 3, 3, 3).astype("float32")
+_ct_w = R(84).randn(2, 2, 2, 2, 2).astype("float32")
+case("conv3d_transpose",
+     inputs={"Input": _ct_x, "Filter": _ct_w},
+     attrs={"strides": [2, 2, 2], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1], "groups": 1},
+     refs={}, out="Output", grad=("Input",), gatol=2e-2, grtol=2e-2)
+
+_p3x = R(85).randn(2, 2, 4, 4, 4).astype("float32")
+case("pool3d", inputs={"X": _p3x},
+     attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+            "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+     refs={"Out": _p3x.reshape(2, 2, 2, 2, 2, 2, 2, 2)
+           .max(axis=(3, 5, 7))})
+case("pool3d", inputs={"X": _p3x},
+     attrs={"pooling_type": "avg", "ksize": [2, 2, 2],
+            "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+     refs={"Out": _p3x.reshape(2, 2, 2, 2, 2, 2, 2, 2)
+           .astype("float64").mean(axis=(3, 5, 7)).astype("float32")},
+     grad=("X",), tag="avg")
+
 _spd = (lambda a: a @ a.T + 3.0 * np.eye(4, dtype="float32"))(
     R(41).randn(4, 4).astype("float32"))
 case("cholesky",

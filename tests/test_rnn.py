@@ -1,0 +1,143 @@
+"""RNN family vs numpy recurrence references (nn/layer/rnn.py parity)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _p(t):
+    return np.asarray(t.numpy(), "float64")
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.LSTMCell(4, 6)
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4).astype("float32")
+    h0 = rs.randn(3, 6).astype("float32")
+    c0 = rs.randn(3, 6).astype("float32")
+    h, (h2, c2) = cell(paddle.to_tensor(x),
+                       (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    wi, wh = _p(cell.weight_ih), _p(cell.weight_hh)
+    bi, bh = _p(cell.bias_ih), _p(cell.bias_hh)
+    gates = x @ wi.T + bi + h0 @ wh.T + bh
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c_ref = _sig(f) * c0 + _sig(i) * np.tanh(g)
+    h_ref = _sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(_p(h2), h_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(_p(c2), c_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    paddle.seed(1)
+    cell = nn.GRUCell(5, 3)
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 5).astype("float32")
+    h0 = rs.randn(2, 3).astype("float32")
+    h, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+    wi, wh = _p(cell.weight_ih), _p(cell.weight_hh)
+    bi, bh = _p(cell.bias_ih), _p(cell.bias_hh)
+    xg = x @ wi.T + bi
+    hg = h0 @ wh.T + bh
+    x_r, x_z, x_c = np.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = np.split(hg, 3, axis=-1)
+    r, z = _sig(x_r + h_r), _sig(x_z + h_z)
+    c = np.tanh(x_c + r * h_c)
+    h_ref = (h0 - c) * z + c
+    np.testing.assert_allclose(_p(h), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_loop_and_reverse():
+    paddle.seed(2)
+    cell = nn.SimpleRNNCell(3, 4)
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 5, 3).astype("float32")
+
+    wi, wh = _p(cell.weight_ih), _p(cell.weight_hh)
+    bi, bh = _p(cell.bias_ih), _p(cell.bias_hh)
+
+    def run_np(rev):
+        h = np.zeros((2, 4))
+        outs = [None] * 5
+        order = range(4, -1, -1) if rev else range(5)
+        for t in order:
+            h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+            outs[t] = h
+        return np.stack(outs, 1), h
+
+    fwd = nn.RNN(cell)
+    out, st = fwd(paddle.to_tensor(x))
+    ro, rh = run_np(False)
+    np.testing.assert_allclose(_p(out), ro, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(_p(st), rh, rtol=1e-5, atol=1e-5)
+
+    bwd = nn.RNN(cell, is_reverse=True)
+    out, st = bwd(paddle.to_tensor(x))
+    ro, rh = run_np(True)
+    np.testing.assert_allclose(_p(out), ro, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(_p(st), rh, rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_length_freeze_and_zero():
+    paddle.seed(3)
+    rnn = nn.GRU(3, 4)
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 6, 3).astype("float32")
+    lens = np.array([4, 6], "int64")
+    out, hf = rnn(paddle.to_tensor(x),
+                  sequence_length=paddle.to_tensor(lens))
+    out_np = _p(out)
+    # outputs past each row's length are zeros
+    assert np.abs(out_np[0, 4:]).max() == 0.0
+    assert np.abs(out_np[1]).min() >= 0.0  # row 1 fully valid
+    # the final state froze at t = len-1 (equals the last valid output)
+    np.testing.assert_allclose(_p(hf)[0, 0], out_np[0, 3], rtol=1e-6)
+    np.testing.assert_allclose(_p(hf)[0, 1], out_np[1, 5], rtol=1e-6)
+
+
+def test_bidirectional_stack_shapes_and_training():
+    paddle.seed(4)
+    lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    rs = np.random.RandomState(4)
+    x = paddle.to_tensor(rs.randn(4, 10, 8).astype("float32"))
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 10, 32]
+    assert h.shape == [4, 4, 16] and c.shape == [4, 4, 16]
+
+    # sequence regression: predict the mean of the inputs
+    head = nn.Linear(32, 1)
+    params = list(lstm.parameters()) + list(head.parameters())
+    o = opt.Adam(0.01, parameters=params)
+    target = paddle.to_tensor(
+        np.asarray(np.mean(np.asarray(x.numpy()), axis=(1, 2)),
+                   "float32")[:, None])
+    losses = []
+    for _ in range(12):
+        seq, _ = lstm(x)
+        pred = head(seq[:, -1])
+        loss = ((pred - target) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_time_major():
+    paddle.seed(5)
+    cell = nn.SimpleRNNCell(3, 4)
+    rs = np.random.RandomState(5)
+    x = rs.randn(5, 2, 3).astype("float32")  # [T, B, C]
+    rnn_tm = nn.RNN(cell, time_major=True)
+    out, st = rnn_tm(paddle.to_tensor(x))
+    assert out.shape == [5, 2, 4]
+    rnn_bm = nn.RNN(cell, time_major=False)
+    out2, st2 = rnn_bm(paddle.to_tensor(x.transpose(1, 0, 2).copy()))
+    np.testing.assert_allclose(_p(out).transpose(1, 0, 2), _p(out2),
+                               rtol=1e-6)

@@ -50,16 +50,22 @@ def test_new_surface_functions_work():
 
 
 def test_default_dtype_roundtrip():
+    import numpy as np
     import pytest
 
     assert paddle.get_default_dtype() == "float32"
     paddle.set_default_dtype("bfloat16")
     try:
         assert paddle.get_default_dtype() == "bfloat16"
+        # creation APIs consult the default (reference behavior)
+        assert "bfloat16" in str(paddle.zeros([2]).dtype)
+        assert "bfloat16" in str(paddle.randn([2]).dtype)
+        assert "bfloat16" in str(paddle.to_tensor(1.5).dtype)
         with pytest.raises(TypeError):
             paddle.set_default_dtype("int32")
     finally:
         paddle.set_default_dtype("float32")
+    assert "float32" in str(paddle.ones([2]).dtype)
 
 
 def test_hub_local_source(tmp_path):
