@@ -18,3 +18,53 @@ from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+# -- surface-completeness batch (reference distributed/__init__.py) ---------
+from .collective import get_group  # noqa: F401
+from . import utils  # noqa: F401
+from . import cloud_utils  # noqa: F401
+
+
+class _PSScopedDataset:
+    """PS-training datasets (fleet/dataset/: InMemoryDataset:?,
+    QueueDataset, BoxPSDataset) feed the C++ DistMultiTrainer loop — the
+    parameter-server path the BASELINE north star leaves untouched.  The
+    names exist so reference imports resolve; instantiation points at the
+    collective-path alternative (paddle.io.DataLoader)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            f"{type(self).__name__} feeds the parameter-server trainer "
+            "loop, which the BASELINE north star scopes out; use "
+            "paddle.io.DataLoader on the collective path instead")
+
+
+class InMemoryDataset(_PSScopedDataset):
+    pass
+
+
+class QueueDataset(_PSScopedDataset):
+    pass
+
+
+class BoxPSDataset(_PSScopedDataset):
+    pass
+
+
+class CountFilterEntry:
+    """PS sparse-table admission config (distributed/entry_attr) — held
+    for strategy-config parity; the PS tables themselves are scoped out."""
+
+    def __init__(self, count_filter: int):
+        self.count_filter = int(count_filter)
+
+    def to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ProbabilityEntry:
+    def __init__(self, probability: float):
+        self.probability = float(probability)
+
+    def to_attr(self):
+        return f"probability_entry:{self.probability}"

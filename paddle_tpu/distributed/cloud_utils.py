@@ -1,0 +1,26 @@
+"""``paddle.distributed.cloud_utils`` — cluster discovery from cloud env.
+
+Parity: ``/root/reference/python/paddle/distributed/cloud_utils.py`` —
+derives the cluster layout from PaddleCloud-style env vars; here the same
+PADDLE_* env protocol feeds the launch_utils Cluster."""
+
+import os
+
+from .launch_utils import Cluster, find_free_port
+
+__all__ = ["get_cluster_and_pod", "get_trainers_num"]
+
+
+def get_trainers_num():
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def get_cluster_and_pod(args=None):
+    ips = os.environ.get("PADDLE_TRAINERS", "127.0.0.1").split(",")
+    nproc = int(os.environ.get("PADDLE_TRAINER_PROCS", 1))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    cluster = Cluster(ips=ips, nproc_per_node=nproc, master=ips[0],
+                      master_port=int(os.environ.get(
+                          "PADDLE_MASTER_PORT", find_free_port())),
+                      node_rank=min(rank, len(ips) - 1))
+    return cluster, cluster.local_ranks()

@@ -1,0 +1,26 @@
+"""``paddle.distributed.utils`` — launcher helper surface.
+
+Parity: ``/root/reference/python/paddle/distributed/utils.py`` (Cluster/
+Pod descriptors + process helpers used by launch).  The live
+implementations are in ``launch_utils.py``; this module re-exports the
+stable names under the reference's module path."""
+
+from .launch_utils import (  # noqa: F401
+    Cluster, TrainerProc, find_free_port, rank_env, start_local_trainers,
+    watch_local_trainers,
+)
+
+__all__ = ["Cluster", "TrainerProc", "find_free_port", "rank_env",
+           "start_local_trainers", "watch_local_trainers", "get_cluster"]
+
+
+def get_cluster(node_ips, node_ip=None, trainer_endpoints=None,
+                device_mode=None, devices_per_proc=None):
+    """Reference-shaped constructor: build a Cluster from node ips +
+    per-node proc count (endpoint details derive from the master)."""
+    ips = list(node_ips) if not isinstance(node_ips, str) else \
+        node_ips.split(",")
+    nproc = (len(devices_per_proc) if devices_per_proc is not None else 1)
+    return Cluster(ips=ips, nproc_per_node=nproc, master=ips[0],
+                   master_port=find_free_port(),
+                   node_rank=ips.index(node_ip) if node_ip in ips else 0)

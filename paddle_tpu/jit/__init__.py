@@ -241,3 +241,79 @@ def load(path: str, **configs) -> TranslatedLayer:
     scope = Scope()
     program, feed_names, fetch_names = static_io.load_inference_model(path, scope=scope)
     return TranslatedLayer(program, feed_names, fetch_names, scope)
+
+
+# -- surface-completeness batch (reference paddle/jit/__init__.py) ---------
+
+declarative = to_static  # legacy decorator name
+
+
+class ProgramTranslator:
+    """Parity: dygraph_to_static ProgramTranslator:759 — global enable
+    switch for to_static conversion (singleton)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)
+
+
+class TracedLayer:
+    """Parity: fluid.dygraph.TracedLayer — trace a layer's forward into a
+    Program and replay it through the Executor."""
+
+    def __init__(self, static_fn, inputs):
+        self._fn = static_fn
+        self._inputs = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf = to_static(layer.forward if hasattr(layer, "forward") else layer)
+        out = sf(*inputs)
+        return out, TracedLayer(sf, inputs)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._fn, path, input_spec=None)
+
+
+_VERBOSITY = 0
+_CODE_LEVEL = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Parity: jit.set_verbosity — dy2static logging level (re-trace
+    strategy has no AST transform logs; the knob is recorded)."""
+    global _VERBOSITY
+    _VERBOSITY = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Parity: jit.set_code_level (no transformed AST to print under the
+    re-trace strategy; recorded for API parity)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)
+
+
+class _Dy2StaticNamespace:
+    """Module-shaped namespace (reference jit re-exports the
+    dygraph_to_static package as ``jit.dy2static``); the re-trace strategy
+    needs no AST transformers, so this exposes the program translator."""
+
+    ProgramTranslator = None  # filled below
+
+
+dy2static = _Dy2StaticNamespace()
+dy2static.ProgramTranslator = ProgramTranslator
+print_function = None  # legacy `from __future__ import print_function` re-export

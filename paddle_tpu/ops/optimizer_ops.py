@@ -220,3 +220,39 @@ def update_loss_scaling_kernel(ins, attrs):
         "OutGoodSteps": good_out,
         "OutBadSteps": bad_out,
     }
+
+
+@register_op("adadelta", no_grad=True)
+def adadelta_kernel(ins, attrs):
+    """Parity: adadelta_op.cc — accumulated-gradient / accumulated-update
+    RMS ratio (no learning rate in the classic formulation; paddle still
+    multiplies by lr)."""
+    p, g = ins["Param"], ins["Grad"]
+    lr = ins["LearningRate"]
+    avg_sq_grad = ins["AvgSquaredGrad"]
+    avg_sq_upd = ins["AvgSquaredUpdate"]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_grad + (1.0 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((avg_sq_upd + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_upd + (1.0 - rho) * jnp.square(upd)
+    return {"ParamOut": p + lr * upd, "AvgSquaredGradOut": g2,
+            "AvgSquaredUpdateOut": u2}
+
+
+@register_op("adamax", no_grad=True)
+def adamax_kernel(ins, attrs):
+    """Parity: adamax_op.cc — infinity-norm Adam variant."""
+    p, g, lr = ins["Param"], ins["Grad"], ins["LearningRate"]
+    m, inf = ins["Moment"], ins["InfNorm"]
+    b1p = ins["Beta1Pow"]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_out = p - (lr / (1.0 - b1p)) * (m_out / (inf_out + eps))
+    # Beta1Pow advances in-graph (works identically in static mode, where
+    # the accumulator is a donated persistable — adam_kernel pattern)
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out,
+            "Beta1PowOut": b1p * b1}

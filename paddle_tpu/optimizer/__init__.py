@@ -28,7 +28,7 @@ from .lr import LRScheduler
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW", "Lamb",
-    "RMSProp", "Adagrad", "lr",
+    "RMSProp", "Adagrad", "Adadelta", "Adamax", "lr",
 ]
 
 lr = lr_sched_mod
@@ -548,6 +548,53 @@ class RMSProp(Optimizer):
             "rmsprop", ins, bind,
             {"decay": self._rho, "epsilon": self._epsilon,
              "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class Adadelta(Optimizer):
+    """Parity: paddle.optimizer.Adadelta (adadelta_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, p, g):
+        g2 = self._add_accumulator("_avg_squared_grad", p)
+        u2 = self._add_accumulator("_avg_squared_update", p)
+        self._run_update(
+            "adadelta",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()],
+             "AvgSquaredGrad": [g2], "AvgSquaredUpdate": [u2]},
+            {"ParamOut": p, "AvgSquaredGradOut": g2,
+             "AvgSquaredUpdateOut": u2},
+            {"rho": self._rho, "epsilon": self._epsilon},
+        )
+
+
+class Adamax(Optimizer):
+    """Parity: paddle.optimizer.Adamax (adamax_op.cc, infinity norm)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, p, g):
+        m = self._add_accumulator("moment", p)
+        inf = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p,
+                                    fill_value=self._beta1, shape=[1])
+        self._run_update(
+            "adamax",
+            {"Param": [p], "Grad": [g], "LearningRate": [self._lr_input()],
+             "Moment": [m], "InfNorm": [inf], "Beta1Pow": [b1p]},
+            {"ParamOut": p, "MomentOut": m, "InfNormOut": inf,
+             "Beta1PowOut": b1p},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon},
         )
 
 

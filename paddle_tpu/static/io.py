@@ -109,3 +109,117 @@ def load_inference_model(path_prefix: str, executor=None, scope=None):
     for name in data.files:
         scope.set(name, jnp.asarray(data[name]))
     return program, meta["feed_names"], meta["fetch_names"]
+
+
+# ---------------------------------------------------------------------------
+# program-state / vars surface (fluid/io.py save_vars:? load_program_state:2144
+# family + 2.x static/io.py serialize_* APIs)
+# ---------------------------------------------------------------------------
+
+
+def load_program_state(model_path: str, var_list=None) -> dict:
+    """Parity: fluid.io.load_program_state — name -> numpy dict."""
+    data = np.load(model_path + ".pdparams.npz", allow_pickle=False)
+    names = ({v.name for v in var_list} if var_list is not None
+             else set(data.files))
+    return {n: data[n] for n in data.files if n in names}
+
+
+def set_program_state(program: fw.Program, state_dict: dict):
+    """Parity: fluid.io.set_program_state — push numpy state into scope."""
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    prog_vars = {v.name for v in program.list_vars() if v.persistable}
+    for name, arr in state_dict.items():
+        if name in prog_vars:
+            scope.set(name, jnp.asarray(arr))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Parity: fluid.io.save_vars — save selected persistables."""
+    program = main_program or fw.default_main_program()
+    allv = [v for v in program.list_vars() if v.persistable]
+    if vars is not None:
+        chosen = list(vars)
+    elif predicate is not None:
+        chosen = [v for v in allv if predicate(v)]
+    else:
+        chosen = allv
+    scope = global_scope()
+    out = {}
+    for v in chosen:
+        val = scope.find_var(v.name)
+        if val is not None:
+            out[v.name] = np.asarray(val)
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, filename or "vars") + ".npz", **out)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Parity: fluid.io.load_vars."""
+    import jax.numpy as jnp
+
+    data = np.load(os.path.join(dirname, filename or "vars") + ".npz",
+                   allow_pickle=False)
+    program = main_program or fw.default_main_program()
+    allv = {v.name for v in program.list_vars() if v.persistable}
+    if vars is not None:
+        allv = {v.name for v in vars}
+    elif predicate is not None:
+        allv = {v.name for v in program.list_vars()
+                if v.persistable and predicate(v)}
+    scope = global_scope()
+    for name in data.files:
+        if name in allv:
+            scope.set(name, jnp.asarray(data[name]))
+
+
+def normalize_program(program: fw.Program, feed_vars, fetch_vars):
+    """Parity: static/io.py normalize_program — prune to the inference
+    slice defined by feeds/fetches (returns the same Program, pruned)."""
+    feeds = [v.name if hasattr(v, "name") else v for v in feed_vars]
+    fetches = [v.name if hasattr(v, "name") else v for v in fetch_vars]
+    _prune_for_inference(program, feeds, fetches)
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs) -> bytes:
+    """Parity: static/io.py serialize_program — program bytes."""
+    program = program or fw.default_main_program()
+    feeds = [v.name if hasattr(v, "name") else v for v in feed_vars]
+    fetches = [v.name if hasattr(v, "name") else v for v in fetch_vars]
+    d = program.to_dict()
+    d["_feed_names"] = feeds
+    d["_fetch_names"] = fetches
+    return json.dumps(d).encode("utf-8")
+
+
+def deserialize_program(data: bytes) -> fw.Program:
+    d = json.loads(bytes(data).decode("utf-8"))
+    return fw.Program.from_dict(d)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs) -> bytes:
+    """Parity: static/io.py serialize_persistables — param bytes."""
+    import io as _io
+
+    program = program or fw.default_main_program()
+    buf = _io.BytesIO()
+    np.savez(buf, **_state_arrays(program, global_scope()))
+    return buf.getvalue()
+
+
+def deserialize_persistables(program: fw.Program, data: bytes,
+                             executor=None):
+    import io as _io
+
+    import jax.numpy as jnp
+
+    arrs = np.load(_io.BytesIO(bytes(data)), allow_pickle=False)
+    scope = global_scope()
+    for name in arrs.files:
+        scope.set(name, jnp.asarray(arrs[name]))

@@ -1,0 +1,43 @@
+"""``paddle.vision.image`` — backend selection + image loading.
+
+Parity: ``/root/reference/python/paddle/vision/image.py`` — a global
+pil/cv2 backend switch consulted by datasets, and ``image_load``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_BACKEND = "pil"
+
+
+def set_image_backend(backend: str):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"expected backend 'pil', 'cv2' or 'tensor', got {backend!r}")
+    global _BACKEND
+    _BACKEND = backend
+
+
+def get_image_backend() -> str:
+    return _BACKEND
+
+
+def image_load(path: str, backend=None):
+    """Load an image with the selected backend (PIL image or HWC array)."""
+    backend = backend or _BACKEND
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"bad backend {backend!r}")
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as np
+
+    arr = np.asarray(img.convert("RGB"))
+    if backend == "cv2":
+        return arr[:, :, ::-1].copy()  # RGB -> BGR like cv2.imread
+    from ..dygraph.tensor import Tensor
+
+    return Tensor(arr)

@@ -1,0 +1,152 @@
+"""``paddle.vision.ops`` — detection op surface.
+
+Parity: ``/root/reference/python/paddle/vision/ops.py`` (yolo_loss,
+yolo_box, deform_conv2d + DeformConv2D).  deform_conv2d is implemented
+via explicit bilinear sampling at offset positions (the deformable_conv
+op role); the YOLO pair raises with guidance — they are detection-head
+specials outside the BASELINE configs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import tensor_api as T
+
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D"]
+
+
+def yolo_loss(*args, **kwargs):
+    raise NotImplementedError(
+        "yolo_loss (yolov3_loss_op.cu) is a detection-head special outside "
+        "the BASELINE configs; compose it from paddle ops or file the need")
+
+
+def yolo_box(*args, **kwargs):
+    raise NotImplementedError(
+        "yolo_box is a detection-head special outside the BASELINE "
+        "configs; compose it from paddle ops or file the need")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 (deformable_conv_op.cu role): bilinear-sample
+    the input at kernel positions + learned offsets, then contract with
+    the weights — expressed as dense gathers XLA fuses."""
+    from ..dygraph import tracer
+
+    s = [stride] * 2 if isinstance(stride, int) else list(stride)
+    p = [padding] * 2 if isinstance(padding, int) else list(padding)
+    d = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+    ins = [x, offset, weight] + ([bias] if bias is not None else []) + (
+        [mask] if mask is not None else [])
+    has_bias = bias is not None
+    has_mask = mask is not None
+
+    def fn(xa, off, w, *rest):
+        import jax.numpy as jnp
+
+        n, cin, h, ww = xa.shape
+        cout, cing, kh, kw = w.shape
+        oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (ww + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        xa = jnp.pad(xa, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        hp, wp = xa.shape[2:]
+        dg = deformable_groups
+        cpg = cin // dg
+        imgf = xa.reshape(n, dg, cpg, hp * wp)
+        # offsets: (n, 2*dg*kh*kw, oh, ow), (dy, dx) interleaved per tap
+        off = off.reshape(n, dg, kh * kw, 2, oh, ow)
+
+        def sample(yy, xx):
+            """Bilinear sample at (yy, xx): (n, dg, oh, ow) ->
+            (n, dg, cpg, oh, ow), zeros outside."""
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            acc = 0.0
+            for oy in (0, 1):
+                for ox in (0, 1):
+                    yc = y0 + oy
+                    xc = x0 + ox
+                    valid = ((yc >= 0) & (yc <= hp - 1)
+                             & (xc >= 0) & (xc <= wp - 1))
+                    yi = jnp.clip(yc, 0, hp - 1).astype(jnp.int32)
+                    xi = jnp.clip(xc, 0, wp - 1).astype(jnp.int32)
+                    flat = (yi * wp + xi).reshape(n, dg, 1, oh * ow)
+                    flat = jnp.broadcast_to(flat, (n, dg, cpg, oh * ow))
+                    g = jnp.take_along_axis(imgf, flat, axis=3)
+                    g = g.reshape(n, dg, cpg, oh, ow)
+                    wgt = ((wy if oy else 1 - wy) * (wx if ox else 1 - wx)
+                           * valid)
+                    acc = acc + g * wgt[:, :, None]
+            return acc
+
+        cols = []
+        for ky in range(kh):
+            for kx in range(kw):
+                tap = ky * kw + kx
+                base_y = jnp.arange(oh)[:, None] * s[0] + ky * d[0]
+                base_x = jnp.arange(ow)[None, :] * s[1] + kx * d[1]
+                yy = base_y[None, None].astype(jnp.float32) \
+                    + off[:, :, tap, 0]
+                xx = base_x[None, None].astype(jnp.float32) \
+                    + off[:, :, tap, 1]
+                g = sample(yy, xx)                 # (n, dg, cpg, oh, ow)
+                if has_mask:
+                    mk = rest[-1].reshape(n, dg, kh * kw, oh, ow)[
+                        :, :, tap]
+                    g = g * mk[:, :, None]
+                cols.append(g)
+        # taps -> im2col matrix: (n, cin * kh * kw, oh, ow) with channel-
+        # major-then-tap layout matching w.reshape(cout, cing*kh*kw)
+        col = jnp.stack(cols, axis=3)              # (n, dg, cpg, K, oh, ow)
+        col = col.reshape(n, cin, kh * kw, oh, ow).reshape(
+            n, cin * kh * kw, oh, ow)
+        wmat = w.reshape(cout, cing * kh * kw)
+        if groups == 1:
+            out = jnp.einsum("ok,nkhw->nohw", wmat, col)
+        else:
+            cols_g = col.reshape(n, groups, (cin // groups) * kh * kw,
+                                 oh, ow)
+            wg = wmat.reshape(groups, cout // groups, -1)
+            out = jnp.einsum("gok,ngkhw->ngohw", wg, cols_g).reshape(
+                n, cout, oh, ow)
+        if has_bias:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out.astype(xa.dtype)
+
+    return tracer.trace_fn(fn, ins, name="deform_conv2d")
+
+
+class DeformConv2D:
+    """Layer form of deform_conv2d (vision/ops.py DeformConv2D)."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..nn.layer_base import Layer
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                k = ([kernel_size] * 2 if isinstance(kernel_size, int)
+                     else list(kernel_size))
+                self._attrs = (stride, padding, dilation, deformable_groups,
+                               groups)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups] + k,
+                    attr=weight_attr)
+                self.bias = (None if bias_attr is False
+                             else self.create_parameter(
+                                 [out_channels], attr=bias_attr,
+                                 is_bias=True))
+
+            def forward(self, x, offset, mask=None):
+                s, p, d, dg, g = self._attrs
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     s, p, d, dg, g, mask)
+
+        return _DeformConv2D(*args, **kwargs)

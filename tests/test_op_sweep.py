@@ -321,6 +321,41 @@ case("pool3d", inputs={"X": _p3x},
            .astype("float64").mean(axis=(3, 5, 7)).astype("float32")},
      grad=("X",), tag="avg")
 
+_adl_p = R(86).randn(3, 4).astype("float32")
+_adl_g = R(87).randn(3, 4).astype("float32")
+_adl_g2 = np.abs(R(88).randn(3, 4)).astype("float32")
+_adl_u2 = np.abs(R(89).randn(3, 4)).astype("float32")
+_adl_rho, _adl_eps = 0.95, 1e-6
+_adl_g2o = _adl_rho * _adl_g2 + (1 - _adl_rho) * _adl_g ** 2
+_adl_upd = -np.sqrt((_adl_u2 + _adl_eps) / (_adl_g2o + _adl_eps)) * _adl_g
+case("adadelta",
+     inputs={"Param": _adl_p, "Grad": _adl_g,
+             "LearningRate": np.array([0.1], "float32"),
+             "AvgSquaredGrad": _adl_g2, "AvgSquaredUpdate": _adl_u2},
+     attrs={"rho": _adl_rho, "epsilon": _adl_eps},
+     out="ParamOut",
+     refs={"ParamOut": (_adl_p + 0.1 * _adl_upd).astype("float32"),
+           "AvgSquaredGradOut": _adl_g2o.astype("float32"),
+           "AvgSquaredUpdateOut": (_adl_rho * _adl_u2
+                                   + (1 - _adl_rho) * _adl_upd ** 2
+                                   ).astype("float32")})
+
+_amx_m = R(90).randn(3, 4).astype("float32")
+_amx_inf = np.abs(R(91).randn(3, 4)).astype("float32")
+_amx_mo = 0.9 * _amx_m + 0.1 * _adl_g
+_amx_io = np.maximum(0.999 * _amx_inf, np.abs(_adl_g))
+case("adamax",
+     inputs={"Param": _adl_p, "Grad": _adl_g,
+             "LearningRate": np.array([0.1], "float32"),
+             "Moment": _amx_m, "InfNorm": _amx_inf,
+             "Beta1Pow": np.array([0.9], "float32")},
+     attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+     out="ParamOut",
+     refs={"ParamOut": (_adl_p - (0.1 / (1 - 0.9))
+                        * (_amx_mo / (_amx_io + 1e-8))).astype("float32"),
+           "MomentOut": _amx_mo.astype("float32"),
+           "InfNormOut": _amx_io.astype("float32")})
+
 _spd = (lambda a: a @ a.T + 3.0 * np.eye(4, dtype="float32"))(
     R(41).randn(4, 4).astype("float32"))
 case("cholesky",

@@ -130,3 +130,345 @@ def test_crop_negative_shape_semantics():
     assert out.shape == [3, 3]  # rows 1..3, NOT clamped back to row 0
     np.testing.assert_allclose(np.asarray(out.numpy()),
                                np.arange(20).reshape(4, 5)[1:4, 0:3])
+
+
+# Full export surfaces of the reference's submodule __init__ files,
+# snapshotted (same method as REFERENCE_TOP_LEVEL).
+REFERENCE_SUBMODULE_SURFACE = {
+ "static": [
+  "BuildStrategy",
+  "CompiledProgram",
+  "ExecutionStrategy",
+  "Executor",
+  "InputSpec",
+  "ParallelExecutor",
+  "Print",
+  "Program",
+  "Scope",
+  "Variable",
+  "WeightNormParamAttr",
+  "accuracy",
+  "amp",
+  "append_backward",
+  "auc",
+  "cpu_places",
+  "create_global_var",
+  "create_parameter",
+  "cuda_places",
+  "data",
+  "default_main_program",
+  "default_startup_program",
+  "deserialize_persistables",
+  "deserialize_program",
+  "device_guard",
+  "global_scope",
+  "gradients",
+  "load",
+  "load_from_file",
+  "load_inference_model",
+  "load_program_state",
+  "load_vars",
+  "name_scope",
+  "nn",
+  "normalize_program",
+  "program_guard",
+  "py_func",
+  "save",
+  "save_inference_model",
+  "save_to_file",
+  "save_vars",
+  "scope_guard",
+  "serialize_persistables",
+  "serialize_program",
+  "set_program_state",
+  "xpu_places"
+ ],
+ "optimizer": [
+  "Adadelta",
+  "Adagrad",
+  "Adam",
+  "AdamW",
+  "Adamax",
+  "Lamb",
+  "Momentum",
+  "Optimizer",
+  "RMSProp",
+  "SGD",
+  "lr"
+ ],
+ "distributed": [
+  "BoxPSDataset",
+  "CountFilterEntry",
+  "InMemoryDataset",
+  "ParallelEnv",
+  "ProbabilityEntry",
+  "QueueDataset",
+  "ReduceOp",
+  "all_gather",
+  "all_reduce",
+  "alltoall",
+  "barrier",
+  "broadcast",
+  "cloud_utils",
+  "get_group",
+  "get_rank",
+  "get_world_size",
+  "init_parallel_env",
+  "new_group",
+  "recv",
+  "reduce",
+  "scatter",
+  "send",
+  "spawn",
+  "split",
+  "utils",
+  "wait"
+ ],
+ "vision": [
+  "LeNet",
+  "datasets",
+  "get_image_backend",
+  "image_load",
+  "models",
+  "ops",
+  "set_image_backend",
+  "transforms"
+ ],
+ "jit": [
+  "ProgramTranslator",
+  "TracedLayer",
+  "TranslatedLayer",
+  "declarative",
+  "dy2static",
+  "load",
+  "not_to_static",
+  "print_function",
+  "save",
+  "set_code_level",
+  "set_verbosity",
+  "to_static"
+ ],
+ "nn": [
+  "AdaptiveAvgPool1D",
+  "AdaptiveAvgPool2D",
+  "AdaptiveAvgPool3D",
+  "AdaptiveMaxPool1D",
+  "AdaptiveMaxPool2D",
+  "AdaptiveMaxPool3D",
+  "AlphaDropout",
+  "AvgPool1D",
+  "AvgPool2D",
+  "AvgPool3D",
+  "BCELoss",
+  "BCEWithLogitsLoss",
+  "BatchNorm",
+  "BatchNorm1D",
+  "BatchNorm2D",
+  "BatchNorm3D",
+  "BeamSearchDecoder",
+  "BiRNN",
+  "Bilinear",
+  "CTCLoss",
+  "ClipGradByGlobalNorm",
+  "ClipGradByNorm",
+  "ClipGradByValue",
+  "Conv1D",
+  "Conv1DTranspose",
+  "Conv2D",
+  "Conv2DTranspose",
+  "Conv3D",
+  "Conv3DTranspose",
+  "CosineSimilarity",
+  "CrossEntropyLoss",
+  "Dropout",
+  "Dropout2D",
+  "Dropout3D",
+  "ELU",
+  "Embedding",
+  "Flatten",
+  "GELU",
+  "GRU",
+  "GRUCell",
+  "GroupNorm",
+  "HSigmoidLoss",
+  "Hardshrink",
+  "Hardsigmoid",
+  "Hardswish",
+  "Hardtanh",
+  "InstanceNorm1D",
+  "InstanceNorm2D",
+  "InstanceNorm3D",
+  "KLDivLoss",
+  "L1Loss",
+  "LSTM",
+  "LSTMCell",
+  "Layer",
+  "LayerDict",
+  "LayerList",
+  "LayerNorm",
+  "LeakyReLU",
+  "Linear",
+  "LocalResponseNorm",
+  "LogSigmoid",
+  "LogSoftmax",
+  "MSELoss",
+  "MarginRankingLoss",
+  "MaxPool1D",
+  "MaxPool2D",
+  "MaxPool3D",
+  "Maxout",
+  "MultiHeadAttention",
+  "NLLLoss",
+  "PReLU",
+  "Pad1D",
+  "Pad2D",
+  "Pad3D",
+  "PairwiseDistance",
+  "ParameterList",
+  "PixelShuffle",
+  "RNN",
+  "RNNCellBase",
+  "ReLU",
+  "ReLU6",
+  "SELU",
+  "Sequential",
+  "Sigmoid",
+  "Silu",
+  "SimpleRNN",
+  "SimpleRNNCell",
+  "SmoothL1Loss",
+  "Softmax",
+  "Softplus",
+  "Softshrink",
+  "Softsign",
+  "SpectralNorm",
+  "Swish",
+  "SyncBatchNorm",
+  "Tanh",
+  "Tanhshrink",
+  "ThresholdedReLU",
+  "Transformer",
+  "TransformerDecoder",
+  "TransformerDecoderLayer",
+  "TransformerEncoder",
+  "TransformerEncoderLayer",
+  "Unfold",
+  "Upsample",
+  "UpsamplingBilinear2D",
+  "UpsamplingNearest2D",
+  "dynamic_decode",
+  "functional",
+  "initializer",
+  "loss",
+  "quant",
+  "spectral_norm",
+  "utils"
+ ],
+ "metric": [
+  "Accuracy",
+  "Auc",
+  "Metric",
+  "Precision",
+  "Recall",
+  "accuracy"
+ ],
+ "io": [
+  "BatchSampler",
+  "ChainDataset",
+  "ComposeDataset",
+  "DataLoader",
+  "Dataset",
+  "DistributedBatchSampler",
+  "IterableDataset",
+  "RandomSampler",
+  "Sampler",
+  "SequenceSampler",
+  "Subset",
+  "TensorDataset",
+  "WeightedRandomSampler",
+  "get_worker_info",
+  "random_split"
+ ],
+ "amp": [
+  "GradScaler",
+  "auto_cast"
+ ]
+}
+
+
+def test_submodule_surfaces_resolve():
+    missing = []
+    for mod, names in REFERENCE_SUBMODULE_SURFACE.items():
+        ours = getattr(paddle, mod)
+        missing += [f"{mod}.{n}" for n in names if not hasattr(ours, n)]
+    assert not missing, f"missing submodule names: {missing}"
+
+
+def test_new_optimizers_train():
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    for cls in (opt.Adadelta, opt.Adamax):
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        o = cls(learning_rate=0.1, parameters=net.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                             .astype("float32"))
+        y = paddle.to_tensor(np.random.RandomState(1).randn(8, 1)
+                             .astype("float32"))
+        losses = []
+        for _ in range(10):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], (cls.__name__, losses)
+
+
+def test_static_state_roundtrip(tmp_path):
+    import numpy as np
+
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import program as fw
+
+        main, startup = fw.Program(), fw.Program()
+        with fw.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            w = paddle.create_parameter([3, 2], "float32", name="w_rt")
+            y = paddle.matmul(x, w)
+        exe = static.Executor()
+        exe.run(startup)
+        path = str(tmp_path / "model")
+        from paddle_tpu.static import io as sio
+
+        sio.save(main, path)
+        state = static.load_program_state(path)
+        assert "w_rt" in state
+        # serialize/deserialize round-trips the program + persistables
+        pb = static.serialize_program([x], [y], program=main)
+        static.save_to_file(str(tmp_path / "m.pdmodel"), pb)
+        prog2 = static.deserialize_program(
+            static.load_from_file(str(tmp_path / "m.pdmodel")))
+        assert any(v.name == "w_rt" for v in prog2.list_vars())
+        params = static.serialize_persistables([x], [y], exe, program=main)
+        import jax.numpy as jnp
+
+        static.global_scope().set("w_rt", jnp.zeros((3, 2), jnp.float32))
+        static.deserialize_persistables(prog2, params, exe)
+        np.testing.assert_allclose(
+            np.asarray(static.global_scope().find_var("w_rt")),
+            state["w_rt"])
+        # scope_guard switches the active scope
+        from paddle_tpu.framework.scope import Scope
+
+        s2 = Scope()
+        with static.scope_guard(s2):
+            assert static.global_scope() is s2
+    finally:
+        paddle.disable_static()
