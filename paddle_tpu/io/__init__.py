@@ -317,18 +317,18 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         item = index_queue.get()
         if item is None:
             break
-        seq, indices = item
+        gen, seq, indices = item
         try:
             batch = collate_fn([dataset[i] for i in indices])
             slot = ring.put(batch) if ring is not None else None
             if slot is not None:
-                data_queue.put((seq, worker_id, "shm", slot))
+                data_queue.put((gen, seq, worker_id, "shm", slot))
             else:
-                data_queue.put((seq, worker_id, "pkl", batch))
+                data_queue.put((gen, seq, worker_id, "pkl", batch))
         except Exception as e:  # ship the error to the main process
             import traceback
 
-            data_queue.put((seq, worker_id, "err", RuntimeError(
+            data_queue.put((gen, seq, worker_id, "err", RuntimeError(
                 f"DataLoader worker {worker_id} failed: {e}\n{traceback.format_exc()}"
             )))
     if ring is not None:
@@ -462,6 +462,12 @@ class DataLoader:
             index_queues, data_queue, workers, rings = self._pool
         else:
             index_queues, data_queue, workers, rings = self._spawn_pool()
+        # Generation id: every epoch's messages are tagged, so a batch a
+        # worker was still computing when the previous epoch was abandoned
+        # is recognized and dropped instead of colliding with the new
+        # epoch's restarted seq numbering.
+        self._generation = getattr(self, "_generation", 0) + 1
+        gen = self._generation
         inflight = 0
         try:
             batches = list(self.batch_sampler)
@@ -474,11 +480,18 @@ class DataLoader:
             while next_yield < n:
                 while next_send < n and inflight < max_inflight:
                     index_queues[next_send % self.num_workers].put(
-                        (next_send, batches[next_send])
+                        (gen, next_send, batches[next_send])
                     )
                     next_send += 1
                     inflight += 1
-                seq, wid, kind, payload = data_queue.get(timeout=self.timeout)
+                mgen, seq, wid, kind, payload = data_queue.get(
+                    timeout=self.timeout)
+                if mgen != gen:
+                    # stale message from an abandoned epoch: release its shm
+                    # slot and ignore it (it was never counted in inflight)
+                    if kind == "shm":
+                        rings[wid].release(payload)
+                    continue
                 inflight -= 1
                 if kind == "err":
                     raise payload
@@ -492,16 +505,16 @@ class DataLoader:
             if not self.persistent_workers:
                 self._shutdown_pool((index_queues, data_queue, workers, rings))
             elif inflight > 0:
-                # epoch abandoned mid-flight (break / worker error): drain the
-                # stale messages so the next epoch's seq numbering can't
-                # collide with them, and release their shm slots so the ring
-                # doesn't leak BUSY slots
+                # epoch abandoned mid-flight (break / worker error): best-
+                # effort drain to free shm slots promptly; anything a worker
+                # is still computing is caught by the generation check above
                 while inflight > 0:
                     try:
-                        _, wid, kind, payload = data_queue.get(
-                            timeout=self.timeout)
+                        mgen, _seq, wid, kind, payload = data_queue.get(
+                            timeout=1.0)
                     except queue.Empty:
                         break
-                    inflight -= 1
+                    if mgen == gen:
+                        inflight -= 1
                     if kind == "shm":
-                        rings[wid].get(payload)
+                        rings[wid].release(payload)

@@ -158,6 +158,10 @@ class ShmRing:
             mv[off:off + n] = flat; off += n
         return slot
 
+    def release(self, slot: int):
+        """Free ``slot`` without deserializing it (stale-message discard)."""
+        self._lib.srb_release(self._h, slot)
+
     def get(self, slot: int):
         """Deserialize the object in ``slot`` and free the slot."""
         src = self._lib.srb_data(self._h, slot)
@@ -172,8 +176,10 @@ class ShmRing:
             bufs = []
             for _ in range(nbuf):
                 (n,) = struct.unpack_from("<Q", mv, off); off += 8
-                # copy out so the slot can be recycled immediately
-                bufs.append(bytes(mv[off:off + n])); off += n
+                # copy out (so the slot can be recycled immediately) into a
+                # bytearray: reconstructed ndarrays must be writeable, same
+                # as the pickle-through-queue fallback path yields
+                bufs.append(bytearray(mv[off:off + n])); off += n
             return pickle.loads(pick, buffers=bufs)
         finally:
             del mv

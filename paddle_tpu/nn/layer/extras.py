@@ -148,7 +148,10 @@ class SpectralNorm(Layer):
             np.random.RandomState(1).randn(w).astype("float32"))
 
     def forward(self, weight):
+        import jax
+
         from ...dygraph import tracer
+        from ...framework import program as fw
 
         dim, iters, eps = self.dim, self.power_iters, self.eps
 
@@ -163,10 +166,18 @@ class SpectralNorm(Layer):
                 u = mat @ v
                 u = u / (jnp.linalg.norm(u) + eps)
             sigma = u @ mat @ v
-            return w / sigma
+            return (w / sigma, jax.lax.stop_gradient(u),
+                    jax.lax.stop_gradient(v))
 
-        return tracer.trace_fn(fn, [weight, self.weight_u, self.weight_v],
-                               name="spectral_norm")
+        out, u_new, v_new = tracer.trace_fn(
+            fn, [weight, self.weight_u, self.weight_v], name="spectral_norm")
+        if fw.in_dygraph_mode():
+            # carry the power-iteration state across steps (the reference
+            # hook does the same) so sigma converges even at power_iters=1;
+            # set_value takes the device array directly — no host round-trip
+            self.weight_u.set_value(u_new._array)
+            self.weight_v.set_value(v_new._array)
+        return out
 
 
 class LayerDict(Layer):

@@ -21,8 +21,9 @@ from . import proto
 
 
 class _Ctx:
-    def __init__(self, block):
+    def __init__(self, block, opset=17):
         self.block = block
+        self.opset = opset  # requested target opset (node-form selection)
         self.nodes: List[bytes] = []
         self.extra_inits: List[bytes] = []
         self.min_opset = 13  # raised by converters needing newer forms
@@ -104,11 +105,18 @@ def _cv_matmul(ctx, op):
     ctx.emit("MatMul", [x, y], [op.output("Out")[0]])
 
 
+def _onnx_pads(paddings):
+    """Paddle 2-elt [h, w] or 4-elt [top, bottom, left, right] paddings
+    (ops/nn_ops.py:_conv_padding) -> ONNX [top, left, bottom, right]."""
+    p = [int(v) for v in paddings]
+    if len(p) == 2:
+        return [p[0], p[1], p[0], p[1]]
+    return [p[0], p[2], p[1], p[3]]
+
+
 def _cv_conv2d(ctx, op):
     a = op.attrs
-    pads = list(a.get("paddings", [0, 0]))
-    if len(pads) == 2:
-        pads = [pads[0], pads[1], pads[0], pads[1]]
+    pads = _onnx_pads(a.get("paddings", [0, 0]))
     ctx.emit("Conv", [op.input("Input")[0], op.input("Filter")[0]],
              [op.output("Output")[0]],
              strides=list(a.get("strides", [1, 1])),
@@ -145,9 +153,7 @@ def _cv_pool2d(ctx, op):
         ctx.emit(kind, [x], [out], kernel_shape=kern, strides=kern,
                  pads=[0, 0, 0, 0])
         return
-    pads = list(a.get("paddings", [0, 0]))
-    if len(pads) == 2:
-        pads = [pads[0], pads[1], pads[0], pads[1]]
+    pads = _onnx_pads(a.get("paddings", [0, 0]))
     kind = "AveragePool" if a.get("pooling_type") == "avg" else "MaxPool"
     attrs = dict(kernel_shape=list(a.get("ksize")),
                  strides=list(a.get("strides", a.get("ksize"))),
@@ -310,8 +316,10 @@ def _cv_reduce(onnx_type):
         keep = int(bool(a.get("keep_dim", a.get("keepdim", False))))
         have_axes = axes is not None and not a.get("reduce_all", False)
         axes = [int(v) for v in np.atleast_1d(axes)] if have_axes else None
-        if onnx_type == "ReduceSum":
-            # opset >= 13: ReduceSum takes axes as an INPUT
+        if onnx_type == "ReduceSum" or ctx.opset >= 18:
+            # ReduceSum takes axes as an INPUT from opset 13; the other
+            # reductions (Mean/Max/...) switch from attribute to input at
+            # opset 18 — emit the right form for the requested target.
             ins = [op.input("X")[0]]
             if axes is not None:
                 ins.append(ctx.const_i64(axes, "axes"))
@@ -413,7 +421,7 @@ def convert_program(program, scope, feed_names: List[str],
     from ..framework.dtype import convert_dtype
 
     block = program.global_block()
-    ctx = _Ctx(block)
+    ctx = _Ctx(block, opset=opset_version)
     if opset_version < 13:
         raise ValueError(
             "ONNX export emits opset-13+ node forms (ReduceSum/Squeeze/"

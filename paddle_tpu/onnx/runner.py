@@ -234,7 +234,11 @@ def run(graph: Graph, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
             r = ((xx - mu) / np.sqrt(var + a.get("epsilon", 1e-5))
                  * scale + bias)
         elif op in ("ReduceMean", "ReduceMax"):
-            axes = tuple(a["axes"]) if "axes" in a else None
+            # axes: attribute through opset 17, second input from opset 18
+            if len(x) > 1:
+                axes = tuple(int(v) for v in x[1])
+            else:
+                axes = tuple(a["axes"]) if "axes" in a else None
             f = np.mean if op == "ReduceMean" else np.max
             r = f(x[0], axis=axes, keepdims=bool(a.get("keepdims", 0)))
         elif op == "ReduceSum":

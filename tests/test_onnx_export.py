@@ -143,6 +143,17 @@ def test_padded_avgpool_export_parity():
     assert g.nodes[0]["op"] == "AveragePool"
 
 
+def test_asymmetric_padding_export_parity():
+    """4-element paddle paddings [top,bottom,left,right] must be reordered
+    to ONNX [top,left,bottom,right] (advisor r3 finding)."""
+    paddle.seed(0)
+    model = nn.Sequential(nn.Conv2D(1, 2, 3, padding=[1, 0, 2, 0]), nn.ReLU())
+    x = np.random.RandomState(2).randn(1, 1, 6, 6).astype("float32")
+    g = _roundtrip(model, [jit.InputSpec([1, 1, 6, 6], "float32", "x")], x)
+    conv = next(n for n in g.nodes if n["op"] == "Conv")
+    assert list(conv["attrs"]["pads"]) == [1, 2, 0, 0]  # t,l,b,r
+
+
 def test_approximate_gelu_export_parity():
     class G(nn.Layer):
         def forward(self, x):
