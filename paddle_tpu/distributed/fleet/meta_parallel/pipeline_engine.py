@@ -24,9 +24,19 @@ Stage layout: the engine partitions the ``PipelineLayer``'s layer list into
 ``prologue | homogeneous middle | epilogue``.  The middle (the maximal run of
 layers with identical parameter structure, e.g. transformer blocks) is
 pipelined over 'pp' with ``blocks_per_stage = len(middle) // S`` layers per
-stage; prologue (embedding) and epilogue (final LN + tied head + loss) run
-replicated outside the pipelined region, exactly the reference's stage-0 /
-last-stage extra layers (pp_layers.py:76 partition semantics).
+stage.  Prologue (embedding) and epilogue (final LN + tied head + loss)
+COMPUTE runs on every pp rank, but their parameters and ALL their optimizer
+state are stored sharded 1/S over the 'pp' axis (each param flattened,
+padded to a multiple of S, and laid out ``P('pp')``): XLA all-gathers the
+bf16/fp32 param at its use site and reduce-scatters the grad back, while
+the fp32 master weights and Adam moments never materialize unsharded.  This
+is the ZeRO-3-over-pp answer to the reference's stage-resident extra layers
+(``pp_layers.py:76`` puts the embedding on stage 0, the head on the last
+stage, and needs ``SharedLayerDesc`` + a grad allreduce for the tied
+weight): per-rank bytes for the largest tensors in the model scale as 1/S
+— better balanced than the reference, which concentrates them on the first
+and last ranks — and a tied embedding/head is naturally one shard-stored
+parameter whose two use-site grads autodiff sums, no shared-group comm.
 """
 
 from __future__ import annotations
@@ -387,12 +397,24 @@ class PipelineEngine:
         self._other_objs = other
 
         mesh = self.mesh
-        repl = NamedSharding(mesh, P()) if mesh is not None else None
-
-        def put_repl(a):
-            return jax.device_put(a, repl) if repl is not None else a
-
-        self.other = [put_repl(p._array) for p in other]
+        # prologue/epilogue params: store flattened + padded to a multiple
+        # of S and sharded P('pp') — 1/S persistent bytes per rank for the
+        # param AND everything _init_opt_state derives from it (master
+        # weights, moments inherit this sharding via zeros_like/astype)
+        shard = (NamedSharding(mesh, P(self.axis))
+                 if mesh is not None else None)
+        self._other_meta = []
+        self.other = []
+        for p in other:
+            host = np.asarray(p._array)
+            n = host.size
+            pad = (-n) % self.S
+            self._other_meta.append((tuple(host.shape), host.dtype.name, n))
+            flat = np.concatenate([host.reshape(-1),
+                                   np.zeros((pad,), host.dtype)])
+            self.other.append(
+                jax.device_put(flat, shard) if shard is not None
+                else jnp.asarray(flat))
         # stack middle params: leaf j -> (S, bps, ...) sharded over pp on dim 0
         bps = self.blocks_per_stage
         self.stacked = []
@@ -430,8 +452,10 @@ class PipelineEngine:
             flat = host.reshape((self.S * self.blocks_per_stage,) + host.shape[2:])
             for i, ps in enumerate(self._mid_objs):
                 ps[j]._array = jnp.asarray(flat[i])
-        for p, arr in zip(self._other_objs, self.other):
-            p._array = jnp.asarray(np.asarray(arr))
+        for p, arr, (shape, _dt, n) in zip(self._other_objs, self.other,
+                                           self._other_meta):
+            host = np.asarray(arr)
+            p._array = jnp.asarray(host[:n].reshape(shape))
 
     # -- functional applies ----------------------------------------------
     def _apply_block(self, leaves, h):
@@ -465,15 +489,24 @@ class PipelineEngine:
             p._array = a
         return saved
 
+    def _unpack_other(self, packed):
+        """Padded-1D shard-stored params -> full-shape arrays for compute.
+        Under jit/GSPMD the slice+reshape is where XLA inserts the
+        all-gather; the grad of this op is the matching scatter, so grads
+        land back on the P('pp') layout elementwise with the opt state."""
+        return [a[:n].reshape(shape)
+                for a, (shape, _dt, n) in zip(packed, self._other_meta)]
+
     def _forward_arrays(self, other_arrays, stacked, xs_mb, apply):
         """prologue -> pipelined middle -> epilogue on traced arrays.
         xs_mb: (M, mb, ...); returns the epilogue output Tensor for the
-        flattened batch."""
+        flattened batch.  ``other_arrays`` are the packed 1/S-sharded
+        prologue/epilogue params."""
         from ....dygraph import tracer
         from ....dygraph.tensor import Tensor
 
         M = xs_mb.shape[0]
-        saved = self._swap_other(other_arrays)
+        saved = self._swap_other(self._unpack_other(other_arrays))
         og = tracer.set_grad_enabled(False)
         try:
             flat = xs_mb.reshape((-1,) + xs_mb.shape[2:])
