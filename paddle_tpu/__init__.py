@@ -72,6 +72,19 @@ from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from . import dataset  # noqa: F401  (legacy reader-creator surface)
 from . import linalg  # noqa: F401
+from . import distribution  # noqa: F401
+from . import compat  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import reader  # noqa: F401
+
+# ``paddle.tensor`` module alias (reference exposes the tensor function
+# namespace as a real submodule): make ``import paddle_tpu.tensor`` work
+# and point it at tensor_api, where those functions live here.
+import sys as _sys
+
+from . import tensor_api as tensor  # noqa: F401
+
+_sys.modules[__name__ + ".tensor"] = tensor
 from .framework.flags import get_flags, set_flags  # noqa: F401
 
 from .dygraph.tensor import Tensor, to_tensor  # noqa: F401

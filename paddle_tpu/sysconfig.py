@@ -1,0 +1,25 @@
+"""Paths to the package's headers/libraries — ``paddle.sysconfig``.
+
+Role parity: ``/root/reference/python/paddle/sysconfig.py`` (get_include:20,
+get_lib:37).  Here the include dir carries the custom-op C ABI header
+(``extension/paddle_tpu_ext.h``) and the lib dir holds runtime-built
+shared objects (e.g. the DataLoader shm ring).
+"""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the C/C++ headers (the custom-op ABI)."""
+    root = os.path.abspath(os.path.dirname(__file__))
+    return os.path.join(root, "extension")
+
+
+def get_lib():
+    """Directory containing runtime-built shared libraries (the
+    content-hash build cache used by ``utils.cpp_extension``)."""
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")

@@ -1,0 +1,99 @@
+"""paddle.reader combinators + paddle.compat + paddle.sysconfig.
+
+Parity targets: ``/root/reference/python/paddle/reader/decorator.py``,
+``compat.py``, ``sysconfig.py``.
+"""
+
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def _r(n=10):
+    return lambda: iter(range(n))
+
+
+def test_cache_replays():
+    calls = []
+
+    def creator():
+        calls.append(1)
+        return iter(range(5))
+
+    c = reader.cache(creator)
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert len(calls) == 1  # source consumed exactly once
+
+
+def test_map_readers():
+    r = reader.map_readers(lambda a, b: a + b, _r(4), _r(4))
+    assert list(r()) == [0, 2, 4, 6]
+
+
+def test_shuffle_is_permutation():
+    r = reader.shuffle(_r(20), 7)
+    out = list(r())
+    assert sorted(out) == list(range(20))
+
+
+def test_chain():
+    r = reader.chain(_r(3), _r(2))
+    assert list(r()) == [0, 1, 2, 0, 1]
+
+
+def test_compose_and_alignment():
+    r = reader.compose(_r(3), lambda: iter("abc"))
+    assert list(r()) == [(0, "a"), (1, "b"), (2, "c")]
+    bad = reader.compose(_r(3), _r(5))
+    with pytest.raises(reader.ComposeNotAligned):
+        list(bad())
+    ok = reader.compose(_r(3), _r(5), check_alignment=False)
+    assert list(ok()) == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_buffered_and_firstn():
+    assert list(reader.buffered(_r(6), 2)()) == list(range(6))
+    assert list(reader.firstn(_r(100), 4)()) == [0, 1, 2, 3]
+
+
+def test_xmap_ordered_and_unordered():
+    ordered = reader.xmap_readers(lambda x: x * x, _r(25), 4, 8, order=True)
+    assert list(ordered()) == [i * i for i in range(25)]
+    unordered = reader.xmap_readers(lambda x: x * x, _r(25), 4, 8)
+    assert sorted(unordered()) == sorted(i * i for i in range(25))
+
+
+def test_multiprocess_reader_interleaves():
+    r = reader.multiprocess_reader([_r(5), _r(5)])
+    assert sorted(r()) == sorted(list(range(5)) * 2)
+
+
+def test_compat():
+    c = paddle.compat
+    assert c.to_text(b"abc") == "abc"
+    assert c.to_text(["a", b"b"]) == ["a", "b"]
+    assert c.to_bytes("xy") == b"xy"
+    d = {b"k": b"v"}
+    out = c.to_text(d)
+    assert out == {"k": "v"}
+    assert c.round(2.5) == 3.0  # half away from zero, not banker's
+    assert c.round(-2.5) == -3.0
+    assert c.floor_division(7, 2) == 3
+    assert c.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.isdir(inc)
+    assert os.path.exists(os.path.join(inc, "paddle_tpu_ext.h"))
+    assert isinstance(paddle.sysconfig.get_lib(), str)
+
+
+def test_tensor_module_alias():
+    import paddle_tpu.tensor as pt
+
+    assert pt.concat is paddle.concat

