@@ -109,6 +109,11 @@ class Tensor:
     def __int__(self):
         return int(self._array)
 
+    def __index__(self):
+        # lets a 1-element integer tensor drive range()/indexing, matching
+        # the reference's eager-tensor int conversion
+        return int(np.asarray(self._array).reshape(-1)[0])
+
     def __bool__(self):
         return bool(self._array)
 

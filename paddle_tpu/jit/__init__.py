@@ -4,13 +4,19 @@ Parity: ``/root/reference/python/paddle/fluid/dygraph/jit.py`` +
 ``dygraph_to_static/program_translator.py`` (``StaticFunction``:232) and the
 C++ ``imperative/jit/program_desc_tracer.h`` (TracedLayer).
 
-TPU-first conversion strategy: instead of the reference's AST-rewriting
-dy2static (27 transformer files), the SAME layer/functional code is re-run
+TPU-first conversion strategy: the SAME layer/functional code is re-run
 in STATIC mode — every dispatch() builds ops instead of executing them, so
 tracing IS program capture (the ProgramDescTracer approach, but needing no
-separate tape→desc conversion).  Python control flow is evaluated at trace
-time over static shapes; data-dependent branching needs lax.cond-style ops
-(documented limitation, same as jax.jit).
+separate tape→desc conversion).  Data-dependent Python control flow
+(``if <Tensor>`` / ``while <Tensor>`` / ``for i in range(<Tensor>)``) is
+handled by ONE focused AST pass (``dy2static.py`` — the role of the
+reference's 27-file transformer suite) that rewrites those statements into
+runtime-dispatched ``cond``/``while_loop`` builders, which lower to
+``lax.cond``/``lax.while_loop`` inside the single jitted program;
+Python-valued conditions keep plain-Python trace-time semantics.
+Conversion applies to the decorated function itself — helpers it calls run
+under the same static trace and convert their tensor control flow via the
+eager builders (``static.control_flow``) directly.
 """
 
 from __future__ import annotations
@@ -86,7 +92,15 @@ class StaticFunction:
                 param_map = {}
                 if isinstance(owner, Layer):
                     param_map = self._bind_params(owner, main, startup)
-                out = self._fn(*sym_args)
+                # dy2static: rewrite data-dependent Python control flow
+                # (if/while/for over tensors) into cond/while_loop ops
+                from . import dy2static
+
+                conv = dy2static.convert_func(self._fn)
+                if conv is not self._fn and owner is not None:
+                    out = conv(owner, *sym_args)
+                else:
+                    out = conv(*sym_args)
             finally:
                 fw.disable_static()
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -306,14 +320,9 @@ def set_code_level(level=100, also_to_stdout=False):
     _CODE_LEVEL = int(level)
 
 
-class _Dy2StaticNamespace:
-    """Module-shaped namespace (reference jit re-exports the
-    dygraph_to_static package as ``jit.dy2static``); the re-trace strategy
-    needs no AST transformers, so this exposes the program translator."""
+# the real AST conversion engine (reference re-exports dygraph_to_static
+# as ``jit.dy2static``); ProgramTranslator rides on it for API parity
+from . import dy2static  # noqa: E402,F401
 
-    ProgramTranslator = None  # filled below
-
-
-dy2static = _Dy2StaticNamespace()
 dy2static.ProgramTranslator = ProgramTranslator
 print_function = None  # legacy `from __future__ import print_function` re-export

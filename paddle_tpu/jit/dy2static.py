@@ -1,0 +1,521 @@
+"""dy2static front-end: convert data-dependent Python control flow.
+
+Role parity: ``/root/reference/python/paddle/fluid/dygraph/
+dygraph_to_static/program_translator.py:759`` (convert_to_static),
+``ifelse_transformer.py`` and ``loop_transformer.py`` — the AST engine
+that rewrites ``if <Tensor>`` / ``while <Tensor>`` / ``for i in
+range(<Tensor>)`` into conditional/while ops.
+
+TPU-first: the rewrite targets the existing ``static.control_flow``
+``cond``/``while_loop`` builders, which lower into the ONE jitted XLA
+program as ``lax.cond`` / ``lax.while_loop`` / ``lax.fori_loop`` (counted
+loops are recognized and become reverse-differentiable ``fori``).  The
+transformed code dispatches at RUNTIME: a Python-bool condition runs as
+plain Python (trace-time unrolling — jax semantics), a ``Variable``
+condition becomes a real in-graph branch/loop.  Unconvertible patterns
+(``break``/``contin`` inside a converted loop, ``return`` from one branch
+only) raise :class:`ConversionError` naming the source line.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["ConversionError", "convert_func", "convert_ifelse",
+           "convert_while", "Undefined"]
+
+
+class ConversionError(RuntimeError):
+    """A Python construct cannot be converted to static control flow."""
+
+
+class Undefined:
+    """Placeholder for a name not yet bound when a converted region starts
+    (the reference's ``UndefinedVar``).  Any use raises."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def _raise(self, *_a, **_k):
+        raise NameError(
+            f"variable '{self._name}' is referenced before assignment "
+            f"(it was only assigned on one path of converted control flow)")
+
+    __bool__ = __call__ = __add__ = __radd__ = __mul__ = _raise
+    __sub__ = __getitem__ = __getattr__ = _raise  # type: ignore[assignment]
+
+    def __repr__(self):
+        return f"<undefined '{self._name}'>"
+
+
+def capture_args(*thunks):
+    """Evaluate name-reading thunks, mapping unbound names to
+    :class:`Undefined` so converted regions can assign them fresh."""
+    out = []
+    for t in thunks:
+        try:
+            out.append(t())
+        except (NameError, UnboundLocalError):
+            name = t.__code__.co_names or t.__code__.co_freevars or ("?",)
+            out.append(Undefined(name[0]))
+    return tuple(out)
+
+
+def _is_symbolic(v) -> bool:
+    from ..framework.program import Variable
+
+    return isinstance(v, Variable)
+
+
+def _promote(v):
+    """Lift a Python/numpy value into the static graph (loop carries and
+    branch outputs must be Variables)."""
+    if _is_symbolic(v):
+        return v
+    if isinstance(v, Undefined):
+        v._raise()
+    from .. import tensor_api as T
+
+    host = np.asarray(v)
+    if host.ndim == 0:
+        host = host.reshape([1])
+    return T.assign(host)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vals):
+    """Runtime dispatch for a converted ``if``: Python value -> plain
+    Python branch; static Variable -> in-graph ``cond``.
+
+    ``vals`` non-empty = assignment form (branch fns take the modified
+    names and return their tuple); empty = return-merge form (both source
+    branches ended in ``return`` and the raw value is passed through)."""
+    from ..framework import program as fw
+
+    if not _is_symbolic(pred):
+        if hasattr(pred, "_array"):  # eager Tensor: Python bool works
+            pred = bool(np.asarray(pred._array).reshape(-1)[0])
+        return true_fn(*vals) if pred else false_fn(*vals)
+    if fw.in_dygraph_mode():  # defensive: symbolic pred implies static
+        raise ConversionError("symbolic predicate outside static mode")
+    from ..static.control_flow import cond as static_cond
+
+    def _norm(fn):
+        def run():
+            out = fn(*vals)
+            seq = list(out) if isinstance(out, (list, tuple)) else [out]
+            return [_promote(v) for v in seq]
+
+        return run
+
+    outs = static_cond(pred, _norm(true_fn), _norm(false_fn))
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    if not vals:  # return-merge form: hand back the single merged value
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    return tuple(outs)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, vals):
+    """Runtime dispatch for a converted ``while``: probe the condition
+    once; a Python-bool condition runs the loop in Python (trace-time
+    unrolling), a Variable condition lowers to ``while_loop``."""
+    from ..framework import program as fw
+
+    vals = list(vals)
+    if fw.in_dygraph_mode():
+        while _truth(cond_fn(*vals)):
+            vals = list(body_fn(*vals))
+        return tuple(vals)
+
+    block = fw.default_main_program().current_block()
+    start = len(block.ops)
+    probe = cond_fn(*vals)
+    if not _is_symbolic(probe):
+        del block.ops[start:]  # no ops should exist, but be safe
+        while _truth(probe):
+            vals = list(body_fn(*vals))
+            probe = cond_fn(*vals)
+        return tuple(vals)
+    del block.ops[start:]  # drop probe ops; while_loop re-captures
+
+    from ..static.control_flow import while_loop
+
+    sym_vals = [_promote(v) for v in vals]
+    outs = while_loop(lambda *a: cond_fn(*a), lambda *a: list(body_fn(*a)),
+                      sym_vals)
+    return tuple(outs)
+
+
+def _truth(v):
+    if hasattr(v, "_array"):
+        return bool(np.asarray(v._array).reshape(-1)[0])
+    return bool(v)
+
+
+# ---------------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------------
+
+_HELPER_NS = "_pt_dy2st"
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> List[str]:
+    """Function-scope names assigned anywhere in ``stmts`` (nested defs and
+    comprehensions have their own scope and are excluded)."""
+    names: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def _add(self, target):
+            for node in ast.walk(target):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Store):
+                    if not node.id.startswith("_pt_") and node.id not in names:
+                        names.append(node.id)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._add(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._add(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._add(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._add(node.target)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):  # new scope — skip
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _contains(stmts: List[ast.stmt], kinds) -> Optional[ast.stmt]:
+    """First node of ``kinds`` in the statements' own scope (nested
+    function/lambda scopes are not descended into)."""
+
+    class Finder(ast.NodeVisitor):
+        found: Optional[ast.stmt] = None
+
+        def generic_visit(self, node):
+            if self.found is not None:
+                return
+            if isinstance(node, kinds):
+                self.found = node
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # new scope
+            super().generic_visit(node)
+
+    f = Finder()
+    for s in stmts:
+        f.generic_visit(s)
+        if f.found is not None:
+            return f.found
+    return None
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _helper(attr):
+    return ast.Attribute(value=_name(_HELPER_NS), attr=attr, ctx=ast.Load())
+
+
+def _thunks(names: List[str]):
+    """``capture_args(lambda: x, lambda: y, ...)`` call node."""
+    lambdas = [
+        ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=_name(n))
+        for n in names
+    ]
+    return ast.Call(func=_helper("capture_args"), args=lambdas, keywords=[])
+
+
+def _fn_def(fname: str, argnames: List[str], body: List[ast.stmt],
+            returns: List[str]):
+    ret = ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in returns], ctx=ast.Load()))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=a) for a in argnames],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body + [ret],
+        decorator_list=[])
+
+
+def _normalize_tail(body: List[ast.stmt]) -> List[ast.stmt]:
+    """Rewrite early-exit ``if p: return a`` (+ fallthrough) into a
+    balanced if/else whose branches BOTH end in return, so the transformer
+    can merge them with ``convert_ifelse`` (the reference's
+    return_transformer role).  Applies only at tail positions: the
+    function body and branches of already-tail ifs — never inside loops."""
+    out = list(body)
+    for idx, s in enumerate(out):
+        if not isinstance(s, ast.If):
+            continue
+        body_ret = bool(s.body) and isinstance(s.body[-1], ast.Return)
+        orelse_ret = bool(s.orelse) and isinstance(s.orelse[-1], ast.Return)
+        if not (body_ret or orelse_ret):
+            continue  # no clean early-exit (buried returns error later)
+        rest = out[idx + 1:]
+        if rest:
+            # attach the fallthrough to the branch that does not return;
+            # when both already return, the fallthrough is dead code
+            if not body_ret:
+                s.body = s.body + rest
+            elif not orelse_ret:
+                s.orelse = (s.orelse or []) + rest
+            out = out[:idx + 1]
+        s.body = _normalize_tail(s.body)
+        s.orelse = _normalize_tail(s.orelse) if s.orelse else []
+        # a branch that still doesn't end in return falls off the function
+        # end -> explicit ``return None`` so both branches merge
+        if s.body and not isinstance(s.body[-1], ast.Return):
+            s.body = s.body + [ast.copy_location(
+                ast.Return(value=ast.Constant(value=None)), s)]
+        if not s.orelse:
+            s.orelse = [ast.copy_location(
+                ast.Return(value=ast.Constant(value=None)), s)]
+        elif not isinstance(s.orelse[-1], ast.Return):
+            s.orelse = s.orelse + [ast.copy_location(
+                ast.Return(value=ast.Constant(value=None)), s)]
+        break  # everything after idx was folded in (or there was nothing)
+    return out
+
+
+class _Ctr:
+    def __init__(self):
+        self.n = 0
+
+    def next(self):
+        self.n += 1
+        return self.n
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrite if/while/for statements into runtime-dispatched helpers."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.ctr = _Ctr()
+
+    def _err(self, node, why) -> ConversionError:
+        return ConversionError(
+            f"{self.filename}:{getattr(node, 'lineno', '?')}: {why}")
+
+    # -- if/elif/else ---------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        both_ret = (node.body and isinstance(node.body[-1], ast.Return)
+                    and node.orelse
+                    and isinstance(node.orelse[-1], ast.Return))
+        ret_in_body = _contains(node.body + node.orelse, ast.Return)
+        if ret_in_body is not None and not both_ret:
+            raise self._err(
+                ret_in_body,
+                "'return' inside one branch of a convertible 'if' — either "
+                "return from BOTH branches or assign to a variable and "
+                "return after the if")
+        k = self.ctr.next()
+        tname, fname = f"_pt_true_{k}", f"_pt_false_{k}"
+        if both_ret:
+            # both branches return: the converted region returns the merge
+            tbody = node.body[:-1] + [ast.Return(value=node.body[-1].value)]
+            fbody = (node.orelse[:-1]
+                     + [ast.Return(value=node.orelse[-1].value)])
+            empty_args = ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[])
+            tdef = ast.FunctionDef(name=tname, args=empty_args, body=tbody,
+                                   decorator_list=[])
+            fdef = ast.FunctionDef(
+                name=fname,
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=fbody, decorator_list=[])
+            call = ast.Call(
+                func=_helper("convert_ifelse"),
+                args=[node.test, _name(tname), _name(fname),
+                      ast.Tuple(elts=[], ctx=ast.Load())],
+                keywords=[])
+            out: List[ast.stmt] = [tdef, fdef, ast.Return(value=call)]
+            return [ast.copy_location(s, node) for s in out]
+
+        modified = sorted(set(_assigned_names(node.body)
+                              + _assigned_names(node.orelse)))
+        if not modified:
+            # side-effect-only branches (prints, list.append, method calls)
+            # keep Python semantics; a symbolic pred will fail loudly in
+            # Tensor.__bool__ at trace time, which is the jax behavior
+            return node
+        tdef = _fn_def(tname, modified, node.body or [ast.Pass()], modified)
+        fdef = _fn_def(fname, modified, node.orelse or [ast.Pass()], modified)
+        call = ast.Call(
+            func=_helper("convert_ifelse"),
+            args=[node.test, _name(tname), _name(fname), _thunks(modified)],
+            keywords=[])
+        target = ast.Tuple(elts=[_name(n, ast.Store()) for n in modified],
+                           ctx=ast.Store())
+        assign = ast.Assign(targets=[target], value=call)
+        return [ast.copy_location(s, node) for s in (tdef, fdef, assign)]
+
+    # -- while ----------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        bad = _contains(node.body, (ast.Break, ast.Continue, ast.Return))
+        if bad is not None:
+            kind = type(bad).__name__.lower()
+            raise self._err(
+                bad, f"'{kind}' inside a convertible 'while' loop is not "
+                     f"convertible — restructure with a loop condition/flag")
+        if node.orelse:
+            raise self._err(node, "while/else is not convertible")
+        k = self.ctr.next()
+        cname, bname = f"_pt_cond_{k}", f"_pt_body_{k}"
+        loop_vars = sorted(set(_assigned_names(node.body)))
+        if not loop_vars:
+            return node  # nothing carried: leave as Python
+        cdef = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=a) for a in loop_vars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[])
+        bdef = _fn_def(bname, loop_vars, node.body, loop_vars)
+        call = ast.Call(
+            func=_helper("convert_while"),
+            args=[_name(cname), _name(bname), _thunks(loop_vars)],
+            keywords=[])
+        if len(loop_vars) == 1:
+            target: ast.expr = ast.Tuple(
+                elts=[_name(loop_vars[0], ast.Store())], ctx=ast.Store())
+        else:
+            target = ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in loop_vars],
+                ctx=ast.Store())
+        assign = ast.Assign(targets=[target], value=call)
+        return [ast.copy_location(s, node) for s in (cdef, bdef, assign)]
+
+    # -- for i in range(...) --------------------------------------------
+    def visit_For(self, node: ast.For):
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if not is_range:
+            self.generic_visit(node)
+            return node  # non-range for stays Python (trace-time unroll)
+        if node.orelse:
+            raise self._err(node, "for/else is not convertible")
+        k = self.ctr.next()
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+        sv, ev, tv = f"_pt_start_{k}", f"_pt_stop_{k}", f"_pt_step_{k}"
+        i = node.target.id
+        prelude = [
+            ast.Assign(targets=[_name(sv, ast.Store())], value=start),
+            ast.Assign(targets=[_name(ev, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(tv, ast.Store())], value=step),
+            ast.Assign(targets=[_name(i, ast.Store())], value=_name(sv)),
+        ]
+        # i < stop (positive step assumed for the symbolic path; negative
+        # Python steps still work because the while runs in Python then)
+        test = ast.Compare(left=_name(i), ops=[ast.Lt()],
+                           comparators=[_name(ev)])
+        bump = ast.Assign(
+            targets=[_name(i, ast.Store())],
+            value=ast.BinOp(left=_name(i), op=ast.Add(), right=_name(tv)))
+        wh = ast.While(test=test, body=node.body + [bump], orelse=[])
+        out = [ast.copy_location(s, node) for s in prelude + [wh]]
+        # now convert the while we just built
+        res: List[ast.stmt] = []
+        for s in out:
+            r = self.visit(s) if isinstance(s, ast.While) else s
+            res.extend(r if isinstance(r, list) else [r])
+        return res
+
+
+_CONVERT_CACHE = {}
+
+
+def convert_func(fn: Callable) -> Callable:
+    """Return ``fn`` rewritten for data-dependent control flow, or ``fn``
+    unchanged when there is nothing to convert / no source available."""
+    key = getattr(fn, "__code__", None)
+    if key is None:
+        return fn
+    if key in _CONVERT_CACHE:
+        return _CONVERT_CACHE[key]
+    converted = _convert_uncached(fn)
+    _CONVERT_CACHE[key] = converted
+    return converted
+
+
+def _convert_uncached(fn: Callable) -> Callable:
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _contains(fdef.body, (ast.If, ast.While, ast.For)):
+        return fn  # nothing to do
+
+    fdef.decorator_list = []  # drop @to_static etc. — we are past them
+    fdef.body = _normalize_tail(fdef.body)
+    filename = getattr(inspect.getmodule(fn), "__file__", None) or "<dy2st>"
+    new_tree = _ControlFlowTransformer(filename).visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    # exec in the original globals + resolved closure cells, so module
+    # imports and enclosing-scope names keep working
+    glob = dict(fn.__globals__)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glob[name] = cell.cell_contents
+            except ValueError:
+                pass
+    import paddle_tpu.jit.dy2static as _self
+
+    glob[_HELPER_NS] = _self
+    code = compile(new_tree, filename=f"<dy2static {filename}>", mode="exec")
+    ns = {}
+    exec(code, glob, ns)
+    out = ns[fdef.name]
+    functools.update_wrapper(out, fn)
+    out.__dy2static_converted__ = True
+    return out

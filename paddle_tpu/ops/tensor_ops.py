@@ -15,6 +15,7 @@ Parity: the reference's creation + manipulation op set —
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from ..framework.dtype import to_jax_dtype
@@ -31,6 +32,10 @@ def fill_constant_kernel(ins, attrs):
     value = attrs.get("value", 0.0)
     if isinstance(value, str):
         value = float(value)
+    if isinstance(value, (list, tuple)):
+        # non-scalar constant (e.g. a promoted host array)
+        return {"Out": jnp.asarray(np.asarray(value).reshape(shape),
+                                   dtype=dtype)}
     return {"Out": jnp.full(shape, value, dtype=dtype)}
 
 

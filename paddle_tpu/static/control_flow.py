@@ -87,11 +87,26 @@ def _run_ops(ops, env):
     return env
 
 
-def _const_value(name, blocks):
+def _const_value(name, blocks, _depth=0):
+    """Constant produced for ``name``: a fill_constant (scalar or
+    1-element list value), seen through ``assign``/``cast`` chains (the
+    dy2static promotion path emits assign-of-fill_constant)."""
+    if _depth > 8:
+        return None
     for block in blocks:
         for op in block.ops:
-            if op.type == "fill_constant" and name in op.output_arg_names:
-                return float(op.attrs.get("value"))
+            if name not in op.output_arg_names:
+                continue
+            if op.type == "fill_constant":
+                v = op.attrs.get("value")
+                if isinstance(v, (list, tuple)):
+                    flat = np.asarray(v).reshape(-1)
+                    return float(flat[0]) if flat.size == 1 else None
+                return float(v)
+            if op.type in ("assign", "cast"):
+                src = (op.inputs.get("X") or [None])[0]
+                if src:
+                    return _const_value(src, blocks, _depth + 1)
     return None
 
 
@@ -125,7 +140,8 @@ def _infer_trip_count(cond_ops, cond_out_name, body_ops, body_out_names,
             f"comparison LHS {x!r} is not a loop variable — the counter must "
             f"be one of loop_vars")
     blocks = [fw.default_main_program().global_block(),
-              fw.default_startup_program().global_block()]
+              fw.default_startup_program().global_block(),
+              _FakeBlock(cond_ops)]  # bound may be built inside cond_fn
     bound = _const_value(y, blocks)
     init = _const_value(x, blocks)
     if bound is None or init is None:

@@ -1,0 +1,241 @@
+"""dy2static: data-dependent Python control flow converts to graph ops.
+
+Parity targets: the reference's ``unittests/dygraph_to_static/``
+ifelse/loop suites over ``program_translator.py:759`` +
+``ifelse_transformer.py`` / ``loop_transformer.py``.  Each case runs the
+SAME function eagerly (Python semantics over eager tensors) and through
+``paddle.jit.to_static`` (converted static program) and asserts equality.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.jit import dy2static
+
+
+def _run_both(fn, *arrays):
+    eager = fn(*[paddle.to_tensor(a) for a in arrays])
+    static = jit.to_static(fn)(*[paddle.to_tensor(a) for a in arrays])
+    ev = np.asarray(eager.numpy())
+    sv = np.asarray(static.numpy())
+    np.testing.assert_allclose(sv, ev, rtol=1e-5, atol=1e-6)
+    return ev
+
+
+def test_if_tensor_condition_assignment():
+    def fn(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y + 0.5
+
+    pos = np.ones((2, 3), "float32")
+    neg = -np.ones((2, 3), "float32")
+    assert _run_both(fn, pos)[0, 0] == 2.5
+    assert _run_both(fn, neg)[0, 0] == -1.5
+
+
+def test_if_without_else_branch():
+    def fn(x):
+        y = x + 1.0
+        if y.mean() > 10.0:
+            y = y * 0.0
+        return y
+
+    small = np.ones((3,), "float32")
+    big = np.full((3,), 100.0, "float32")
+    assert _run_both(fn, small)[0] == 2.0
+    assert _run_both(fn, big)[0] == 0.0
+
+
+def test_if_both_branches_return():
+    def fn(x):
+        if x.sum() > 0:
+            return x * 3.0
+        else:
+            return -x
+
+    assert _run_both(fn, np.ones((2,), "float32"))[0] == 3.0
+    assert _run_both(fn, -np.ones((2,), "float32"))[0] == 1.0
+
+
+def test_early_return_with_fallthrough():
+    def fn(x):
+        if x.sum() > 0:
+            return x + 10.0
+        y = x * 2.0
+        return y
+
+    assert _run_both(fn, np.ones((2,), "float32"))[0] == 11.0
+    assert _run_both(fn, -np.ones((2,), "float32"))[0] == -2.0
+
+
+def test_elif_chain():
+    def fn(x):
+        s = x.sum()
+        if s > 10.0:
+            y = x * 100.0
+        elif s > 0.0:
+            y = x * 10.0
+        else:
+            y = x
+        return y
+
+    assert _run_both(fn, np.full((4,), 5.0, "float32"))[0] == 500.0
+    assert _run_both(fn, np.full((4,), 0.5, "float32"))[0] == 5.0
+    assert _run_both(fn, np.full((4,), -1.0, "float32"))[0] == -1.0
+
+
+def test_while_tensor_condition():
+    def fn(x):
+        s = paddle.zeros([1])
+        i = paddle.zeros([1])
+        while s.sum() < x.sum():
+            s = s + 1.0
+            i = i + 2.0
+        return s + i
+
+    # x.sum()=7.2 -> loop runs 8 times -> s=8, i=16
+    out = _run_both(fn, np.full((4,), 1.8, "float32"))
+    assert out[0] == 24.0
+
+
+def test_while_python_condition_stays_python():
+    def fn(x):
+        n = 3
+        while n > 0:
+            x = x + 1.0
+            n -= 1
+        return x
+
+    assert _run_both(fn, np.zeros((2,), "float32"))[0] == 3.0
+
+
+def test_for_range_python_bound():
+    def fn(x):
+        acc = paddle.zeros([1])
+        for i in range(4):
+            acc = acc + x.sum() + float(0 * i)
+        return acc
+
+    out = _run_both(fn, np.ones((2,), "float32"))
+    assert out[0] == 8.0
+
+
+def test_for_range_tensor_bound():
+    def fn(x):
+        n = x.sum().astype("int64")
+        acc = paddle.zeros([1])
+        for i in range(n):
+            acc = acc + 1.5
+        return acc
+
+    out = _run_both(fn, np.full((5,), 1.0, "float32"))
+    assert out[0] == 7.5
+
+
+def test_nested_if_inside_while():
+    def fn(x):
+        s = paddle.zeros([1])
+        k = paddle.zeros([1])
+        while k.sum() < 5.0:
+            if s.sum() > 2.0:
+                s = s + 0.5
+            else:
+                s = s + 1.0
+            k = k + 1.0
+        return s
+
+    # iterations: s = 1, 2, 3 (cross 2 at 3rd), then +0.5, +0.5 -> 4.0
+    out = _run_both(fn, np.zeros((1,), "float32"))
+    assert out[0] == 4.0
+
+
+def test_break_raises_conversion_error():
+    def fn(x):
+        s = paddle.zeros([1])
+        while s.sum() < 5.0:
+            s = s + 1.0
+            if False:
+                break
+        return s
+
+    with pytest.raises(dy2static.ConversionError, match="break"):
+        dy2static.convert_func(fn)
+
+
+def test_one_branch_return_deep_raises():
+    def fn(x):
+        s = paddle.zeros([1])
+        while s.sum() < 3.0:
+            if x.sum() > 0:
+                return s
+            s = s + 1.0
+        return s
+
+    with pytest.raises(dy2static.ConversionError, match="return"):
+        dy2static.convert_func(fn)
+
+
+def test_layer_forward_converts():
+    from paddle_tpu import nn
+
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                out = h * 2.0
+            else:
+                out = h * -1.0
+            return out
+
+    paddle.seed(0)
+    m = Gate()
+    m.eval()
+    x = np.random.RandomState(0).randn(2, 4).astype("float32")
+    eager = np.asarray(m(paddle.to_tensor(x)).numpy())
+    ms = jit.to_static(m)
+    static = np.asarray(ms(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(static, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_counted_loop_is_differentiable_via_fori():
+    """A converted counted loop lowers to fori and supports backward
+    through append_backward (the static training path)."""
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            x.stop_gradient = False
+
+            def body(i, acc):
+                return i + 1, acc + (x * x).sum()
+
+            from paddle_tpu.static.control_flow import while_loop
+
+            i0 = paddle.assign(np.zeros([1], "float32"))
+            a0 = paddle.assign(np.zeros([1], "float32"))
+            iN, aN = while_loop(
+                lambda i, a: i < paddle.assign(np.full([1], 3.0, "float32")),
+                body, [i0, a0])
+            loss = aN.sum()
+            grads = static.append_backward(loss, parameter_list=[x])
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.array([1.0, 2.0], "float32")
+        (gx,) = [g for p, g in grads if p.name == x.name]
+        out = exe.run(main, feed={"x": xv}, fetch_list=[loss, gx])
+        assert float(out[0]) == 15.0  # 3 * (1 + 4)
+        np.testing.assert_allclose(np.asarray(out[1]), 6.0 * xv)
+    finally:
+        paddle.disable_static()
