@@ -14,5 +14,6 @@ from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
 from .dispatch import dispatch, dispatch_dygraph, dispatch_static, single  # noqa: F401
 from .registry import OpNotRegistered, get_op_def, is_registered, register_op  # noqa: F401

@@ -729,3 +729,32 @@ def pool3d_kernel(ins, attrs):
     if not attrs.get("exclusive", True):
         cnt = jnp.full_like(cnt, float(np.prod(ksize)))
     return {"Out": s / cnt}
+
+
+@register_op("data_norm", nondiff_slots=("BatchSize", "BatchSum",
+                                         "BatchSquareSum"),
+             list_slots=())
+def data_norm_kernel(ins, attrs):
+    """Parity: data_norm_op.h — y = (x - sum/size) * sqrt(size/square_sum).
+    In training the accumulators decay + absorb the current batch (the
+    reference does this in its grad op; here it rides the forward):
+    size' = decay*size + B, sum' = decay*sum + sum(x), sq' = decay*sq +
+    sum((x - mean)^2)."""
+    x = ins["X"]
+    size = jax.lax.stop_gradient(ins["BatchSize"])
+    ssum = jax.lax.stop_gradient(ins["BatchSum"])
+    ssq = jax.lax.stop_gradient(ins["BatchSquareSum"])
+    mean = ssum / size
+    scale = jnp.sqrt(size / ssq)
+    y = (x - mean) * scale
+    if attrs.get("is_test", False):
+        return {"Y": y, "BatchSizeOut": size, "BatchSumOut": ssum,
+                "BatchSquareSumOut": ssq}
+    decay = attrs.get("summary_decay_rate", 0.9999999)
+    b = x.shape[0]
+    xs = jax.lax.stop_gradient(x)
+    size_out = decay * size + b
+    sum_out = decay * ssum + jnp.sum(xs, axis=0)
+    sq_out = decay * ssq + jnp.sum(jnp.square(xs - mean), axis=0)
+    return {"Y": y, "BatchSizeOut": size_out, "BatchSumOut": sum_out,
+            "BatchSquareSumOut": sq_out}

@@ -1,0 +1,274 @@
+"""Sequence op family over the padded+mask LoD design.
+
+Role parity: ``/root/reference/paddle/fluid/operators/sequence_ops/``
+(49 files) and the surface ``python/paddle/fluid/layers/sequence_lod.py``.
+
+The reference operates on LoD (ragged) tensors: a flat value buffer plus
+per-sequence offsets.  The TPU-native representation (documented in
+``ops/registry.py``) is a PADDED dense batch ``[B, T, ...]`` plus an
+explicit per-row ``length`` vector ``[B]`` — static shapes for XLA, with
+validity carried by masks.  Every kernel here takes the dense batch in
+slot ``X`` and lengths in slot ``Length`` (absent = all rows full), and
+guarantees that positions at or beyond a row's length neither influence
+valid outputs nor receive nonzero values (except where a pad value is
+explicitly requested).  Lengths are nondiff; values flow gradients via
+the registry's auto-vjp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+def _lengths(ins, x, batch_axis=0):
+    ln = ins.get("Length")
+    if ln is None or (isinstance(ln, list) and not ln):
+        return jnp.full((x.shape[batch_axis],), x.shape[1], dtype=jnp.int32)
+    if isinstance(ln, list):
+        ln = ln[0]
+    return ln.astype(jnp.int32).reshape(-1)
+
+
+def _time_mask(x, lengths):
+    """[B, T] boolean validity mask broadcastable onto x [B, T, ...]."""
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)
+    m = t[None, :] < lengths[:, None]
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_pad", nondiff_slots=("Length",))
+def sequence_pad_kernel(ins, attrs):
+    """Enforce ``pad_value`` beyond each row's length (sequence_pad_op
+    role: here the batch is already rectangular, so padding = masking)."""
+    x = ins["X"]
+    ln = _lengths(ins, x)
+    maxlen = int(attrs.get("maxlen") or 0)
+    if maxlen > 0:
+        if maxlen < x.shape[1]:
+            x = x[:, :maxlen]
+        elif maxlen > x.shape[1]:
+            pad = [(0, 0), (0, maxlen - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, pad)
+        ln = jnp.minimum(ln, maxlen)
+    m = _time_mask(x, ln)
+    pad_value = jnp.asarray(attrs.get("pad_value", 0.0), dtype=x.dtype)
+    return {"Out": jnp.where(m, x, pad_value), "Length": ln}
+
+
+@register_op("sequence_unpad", nondiff_slots=("Length",))
+def sequence_unpad_kernel(ins, attrs):
+    """Zero the pad region (the dense stand-in for returning a ragged
+    tensor; downstream mask-aware ops consume Length)."""
+    x = ins["X"]
+    ln = _lengths(ins, x)
+    return {"Out": jnp.where(_time_mask(x, ln), x, jnp.zeros((), x.dtype))}
+
+
+@register_op("sequence_mask", nondiff_slots=("X",), no_grad=True)
+def sequence_mask_kernel(ins, attrs):
+    ln = ins["X"].astype(jnp.int32).reshape(-1)
+    maxlen = int(attrs.get("maxlen") or 0)
+    if maxlen <= 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen under XLA (dynamic "
+            "max(length) would be a data-dependent shape)")
+    from ..framework.dtype import to_jax_dtype
+
+    dt = to_jax_dtype(attrs.get("out_dtype", "int64"))
+    t = jnp.arange(maxlen, dtype=jnp.int32)
+    return {"Y": (t[None, :] < ln[:, None]).astype(dt)}
+
+
+@register_op("sequence_softmax", nondiff_slots=("Length",))
+def sequence_softmax_kernel(ins, attrs):
+    x = ins["X"]
+    ln = _lengths(ins, x)
+    m = _time_mask(x, ln)
+    neg = jnp.asarray(-1e9, x.dtype)
+    z = jnp.where(m, x, neg)
+    z = z - jax.lax.stop_gradient(jnp.max(z, axis=1, keepdims=True))
+    e = jnp.exp(z) * m.astype(x.dtype)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    return {"Out": e / jnp.maximum(s, jnp.asarray(1e-30, x.dtype))}
+
+
+@register_op("sequence_pool", nondiff_slots=("Length",))
+def sequence_pool_kernel(ins, attrs):
+    x = ins["X"]
+    ln = _lengths(ins, x)
+    m = _time_mask(x, ln).astype(x.dtype)
+    pt = str(attrs.get("pooltype", attrs.get("pool_type", "AVERAGE"))).upper()
+    lnf = jnp.maximum(ln, 1).astype(x.dtype).reshape(
+        (-1,) + (1,) * (x.ndim - 2))
+    xm = x * m
+    if pt == "SUM":
+        out = jnp.sum(xm, axis=1)
+    elif pt == "AVERAGE":
+        out = jnp.sum(xm, axis=1) / lnf
+    elif pt == "SQRT":
+        out = jnp.sum(xm, axis=1) / jnp.sqrt(lnf)
+    elif pt == "MAX":
+        neg = jnp.asarray(-3.4e38 if x.dtype != jnp.float64 else -1e308,
+                          x.dtype)
+        out = jnp.max(jnp.where(m.astype(bool), x, neg), axis=1)
+    elif pt == "FIRST":
+        out = x[:, 0]
+    elif pt == "LAST":
+        idx = jnp.maximum(ln - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype {pt!r}")
+    return {"Out": out}
+
+
+@register_op("sequence_reverse", nondiff_slots=("Length",))
+def sequence_reverse_kernel(ins, attrs):
+    """Reverse each row's VALID prefix; pad region stays in place."""
+    x = ins["X"]
+    ln = _lengths(ins, x)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    src = jnp.where(t < ln[:, None], ln[:, None] - 1 - t, t)
+    idx = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.take_along_axis(
+        x, jnp.broadcast_to(idx, x.shape[:2] + x.shape[2:]), axis=1)}
+
+
+@register_op("sequence_slice", nondiff_slots=("Offset", "SliceLength",
+                                              "Length"))
+def sequence_slice_kernel(ins, attrs):
+    """out[b, j] = x[b, offset[b] + j] for j < slice_len[b], else 0."""
+    x = ins["X"]
+    off = ins["Offset"].astype(jnp.int32).reshape(-1)
+    sl = ins["SliceLength"].astype(jnp.int32).reshape(-1)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    src = jnp.clip(off[:, None] + t, 0, x.shape[1] - 1)
+    idx = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    g = jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=1)
+    m = (t < sl[:, None]).reshape(
+        x.shape[:2] + (1,) * (x.ndim - 2)).astype(x.dtype)
+    return {"Out": g * m, "Length": sl}
+
+
+@register_op("sequence_reshape", nondiff_slots=("Length",))
+def sequence_reshape_kernel(ins, attrs):
+    """[B, T, D] -> [B, T*D/new_dim, new_dim]; lengths scale by D/new_dim
+    (sequence_reshape_op semantics under the dense layout)."""
+    x = ins["X"]
+    ln = _lengths(ins, x)
+    new_dim = int(attrs["new_dim"])
+    d = x.shape[-1]
+    xz = jnp.where(_time_mask(x, ln), x, jnp.zeros((), x.dtype))
+    b, t = x.shape[0], x.shape[1]
+    out = xz.reshape(b, t * d // new_dim, new_dim)
+    return {"Out": out, "Length": (ln * d) // new_dim}
+
+
+@register_op("sequence_concat", list_slots=("X", "Length"),
+             nondiff_slots=("Length",))
+def sequence_concat_kernel(ins, attrs):
+    """Concatenate per-row valid segments, repadded to the summed T."""
+    xs = ins["X"]
+    lens = ins.get("Length") or []
+    if not lens:
+        lens = [jnp.full((x.shape[0],), x.shape[1], jnp.int32) for x in xs]
+    lens = [l.astype(jnp.int32).reshape(-1) for l in lens]
+    T = sum(x.shape[1] for x in xs)
+    b = xs[0].shape[0]
+    trail = xs[0].shape[2:]
+    out = jnp.zeros((b, T) + trail, xs[0].dtype)
+    t_out = jnp.arange(T, dtype=jnp.int32)[None, :]
+    offset = jnp.zeros((b,), jnp.int32)
+    for x, ln in zip(xs, lens):
+        # rows of x land at [offset, offset+ln)
+        rel = t_out - offset[:, None]
+        valid = (rel >= 0) & (rel < ln[:, None])
+        src = jnp.clip(rel, 0, x.shape[1] - 1)
+        idx = src.reshape(src.shape + (1,) * len(trail))
+        g = jnp.take_along_axis(
+            x, jnp.broadcast_to(idx, (b, T) + trail), axis=1)
+        vm = valid.reshape(valid.shape + (1,) * len(trail))
+        out = jnp.where(vm, g, out)
+        offset = offset + ln
+    return {"Out": out, "Length": offset}
+
+
+@register_op("sequence_expand_as", nondiff_slots=("Length",))
+def sequence_expand_as_kernel(ins, attrs):
+    """Broadcast each row vector of X over the valid region given by
+    Length (the dense analogue of repeating row i y_lod[i] times)."""
+    x = ins["X"]  # [B, D...] one entry per sequence
+    ln = ins["Length"].astype(jnp.int32).reshape(-1)
+    maxlen = int(attrs["maxlen"])
+    t = jnp.arange(maxlen, dtype=jnp.int32)[None, :]
+    m = (t < ln[:, None]).reshape(
+        (x.shape[0], maxlen) + (1,) * (x.ndim - 1))
+    out = jnp.broadcast_to(
+        x[:, None], (x.shape[0], maxlen) + x.shape[1:])
+    return {"Out": out * m.astype(x.dtype), "Length": ln}
+
+
+@register_op("sequence_enumerate", nondiff_slots=("X", "Length"),
+             no_grad=True)
+def sequence_enumerate_kernel(ins, attrs):
+    x = ins["X"]  # [B, T] integer ids
+    ln = _lengths(ins, x)
+    win = int(attrs["win_size"])
+    pad = attrs.get("pad_value", 0)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :, None]
+    k = jnp.arange(win, dtype=jnp.int32)[None, None, :]
+    src = t + k  # [1, T, win]
+    valid = (src < ln[:, None, None]) & (t < ln[:, None, None])
+    srcc = jnp.clip(src, 0, x.shape[1] - 1)
+    g = jnp.take_along_axis(
+        x[:, :, None], jnp.broadcast_to(
+            srcc, (x.shape[0], x.shape[1], win)), axis=1)
+    return {"Out": jnp.where(valid, g, jnp.asarray(pad, x.dtype))}
+
+
+@register_op("sequence_scatter", nondiff_slots=("Ids", "Length"))
+def sequence_scatter_kernel(ins, attrs):
+    """out[b, ids[b, n]] += updates[b, n] for n < len_ids[b]."""
+    x = ins["X"]
+    ids = ins["Ids"].astype(jnp.int32)
+    upd = ins["Updates"]
+    ln = ins.get("Length")
+    if ln is None:
+        ln = jnp.full((ids.shape[0],), ids.shape[1], jnp.int32)
+    else:
+        ln = ln.astype(jnp.int32).reshape(-1)
+    n = jnp.arange(ids.shape[1], dtype=jnp.int32)[None, :]
+    m = (n < ln[:, None]).astype(upd.dtype)
+    b_idx = jnp.broadcast_to(
+        jnp.arange(x.shape[0], dtype=jnp.int32)[:, None], ids.shape)
+    return {"Out": x.at[b_idx, jnp.clip(ids, 0, x.shape[1] - 1)].add(
+        upd * m)}
+
+
+@register_op("sequence_conv", nondiff_slots=("Length",))
+def sequence_conv_kernel(ins, attrs):
+    """Context-window convolution over time (sequence_conv_op):
+    out[b, t] = concat(x[b, t+start : t+start+ctx]) @ filter, masked."""
+    x = ins["X"]  # [B, T, D]
+    w = ins["Filter"]  # [ctx*D, F]
+    ln = _lengths(ins, x)
+    ctx = int(attrs.get("contextLength", attrs.get("context_length")))
+    start = int(attrs.get("contextStart", attrs.get("context_start",
+                                                    -(ctx - 1) // 2)))
+    b, t, d = x.shape
+    xz = jnp.where(_time_mask(x, ln), x, jnp.zeros((), x.dtype))
+    cols = []
+    for k in range(ctx):
+        shift = start + k
+        rolled = jnp.roll(xz, -shift, axis=1)
+        tt = jnp.arange(t, dtype=jnp.int32)[None, :]
+        ok = ((tt + shift >= 0) & (tt + shift < ln[:, None]))[..., None]
+        cols.append(jnp.where(ok, rolled, jnp.zeros((), x.dtype)))
+    stacked = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*D]
+    out = stacked @ w  # [B, T, F]
+    return {"Out": out * _time_mask(out, ln).astype(out.dtype)}

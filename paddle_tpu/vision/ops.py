@@ -1,10 +1,18 @@
-"""``paddle.vision.ops`` — detection op surface.
+"""``paddle.vision.ops`` — detection op kit.
 
 Parity: ``/root/reference/python/paddle/vision/ops.py`` (yolo_loss,
-yolo_box, deform_conv2d + DeformConv2D).  deform_conv2d is implemented
-via explicit bilinear sampling at offset positions (the deformable_conv
-op role); the YOLO pair raises with guidance — they are detection-head
-specials outside the BASELINE configs.
+yolo_box, deform_conv2d + DeformConv2D) and the fluid detection surface
+``/root/reference/python/paddle/fluid/layers/detection.py`` (prior_box,
+box_coder, multiclass_nms) + ``roi_align_op`` — the 66-file
+``fluid/operators/detection/`` family re-expressed as dense jnp programs.
+
+TPU-first notes: everything is static-shape.  NMS selection runs as a
+sequential ``fori_loop`` over sorted candidates (no dynamic compaction);
+variable-length outputs (the reference's LoD results) come back PADDED
+with a companion count/index tensor, per the framework's padded+mask LoD
+design (``ops/registry.py``).  Gather-heavy ops (roi_align,
+deform_conv2d) use bilinear gathers that XLA fuses; the matmul contraction
+of deform_conv2d rides the MXU.
 """
 
 from __future__ import annotations
@@ -13,19 +21,606 @@ import numpy as np
 
 from .. import tensor_api as T
 
-__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D"]
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
+           "prior_box", "box_coder", "multiclass_nms", "roi_align",
+           "distribute_fpn_proposals", "generate_proposals"]
 
 
-def yolo_loss(*args, **kwargs):
-    raise NotImplementedError(
-        "yolo_loss (yolov3_loss_op.cu) is a detection-head special outside "
-        "the BASELINE configs; compose it from paddle ops or file the need")
+def _trace(fn, tensors, name):
+    from ..dygraph import tracer
+
+    return tracer.trace_fn(fn, tensors, name=name)
 
 
-def yolo_box(*args, **kwargs):
-    raise NotImplementedError(
-        "yolo_box is a detection-head special outside the BASELINE "
-        "configs; compose it from paddle ops or file the need")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Parity: yolo_box_op.h GetYoloBox/CalcDetectionBox.
+
+    x: [N, an*(5+cls), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, an*H*W, 4], scores [N, an*H*W, cls]); candidates
+    below conf_thresh have zero boxes/scores (the dense stand-in for the
+    reference's skipped entries).
+    """
+    an_num = len(anchors) // 2
+    anchors = [float(a) for a in anchors]
+
+    def fn(xa, imgs):
+        import jax.numpy as jnp
+
+        n, c, h, w = xa.shape
+        xa = xa.reshape(n, an_num, 5 + class_num, h, w)
+        tx, ty, tw, th = xa[:, :, 0], xa[:, :, 1], xa[:, :, 2], xa[:, :, 3]
+        tconf = xa[:, :, 4]
+        tcls = xa[:, :, 5:]
+        sig = lambda v: 1.0 / (1.0 + jnp.exp(-v))  # noqa: E731
+        gx = jnp.arange(w, dtype=xa.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=xa.dtype)[None, None, :, None]
+        img_h = imgs[:, 0].astype(xa.dtype)[:, None, None, None]
+        img_w = imgs[:, 1].astype(xa.dtype)[:, None, None, None]
+        in_w = float(downsample_ratio * w)
+        in_h = float(downsample_ratio * h)
+        bias = (scale_x_y - 1.0) * 0.5
+        cx = (gx + sig(tx) * scale_x_y - bias) / w * img_w
+        cy = (gy + sig(ty) * scale_x_y - bias) / h * img_h
+        aw = jnp.asarray(anchors[0::2], xa.dtype)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], xa.dtype)[None, :, None, None]
+        bw = jnp.exp(tw) * aw * img_w / in_w
+        bh = jnp.exp(th) * ah * img_h / in_h
+        x1 = cx - bw * 0.5
+        y1 = cy - bh * 0.5
+        x2 = cx + bw * 0.5
+        y2 = cy + bh * 0.5
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1.0)
+            y1 = jnp.clip(y1, 0.0, img_h - 1.0)
+            x2 = jnp.clip(x2, 0.0, img_w - 1.0)
+            y2 = jnp.clip(y2, 0.0, img_h - 1.0)
+        conf = sig(tconf)
+        keep = (conf >= conf_thresh).astype(xa.dtype)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        scores = sig(tcls) * (conf * keep)[:, :, None]
+        boxes = boxes.reshape(n, an_num * h * w, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(
+            n, an_num * h * w, class_num)
+        return boxes, scores
+
+    return _trace(fn, [x, img_size], "yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """Parity: yolov3_loss_op.h — location SCE/L1, objectness BCE with
+    ignore-region, classification BCE; best-anchor target assignment.
+
+    x: [N, mask_num*(5+cls), H, W]; gt_box: [N, B, 4] (cx, cy, w, h,
+    normalized); gt_label: [N, B] int.  Returns loss [N].
+    """
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    anchors_f = [float(a) for a in anchors]
+    amask = [int(m) for m in anchor_mask]
+
+    def fn(xa, gbox, glabel, *rest):
+        import jax
+        import jax.numpy as jnp
+
+        gscore = rest[0] if rest else None
+        n, c, h, w = xa.shape
+        xa = xa.reshape(n, mask_num, 5 + class_num, h, w)
+        px, py = xa[:, :, 0], xa[:, :, 1]
+        pw, ph = xa[:, :, 2], xa[:, :, 3]
+        pobj = xa[:, :, 4]
+        pcls = xa[:, :, 5:]
+        sig = lambda v: 1.0 / (1.0 + jnp.exp(-v))  # noqa: E731
+
+        def bce(logit, label):
+            # stable BCE-with-logits
+            return (jnp.maximum(logit, 0) - logit * label
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        in_w = float(downsample_ratio * w)
+        in_h = float(downsample_ratio * h)
+        aw_all = jnp.asarray(anchors_f[0::2], xa.dtype)
+        ah_all = jnp.asarray(anchors_f[1::2], xa.dtype)
+        aw = aw_all[jnp.asarray(amask)]
+        ah = ah_all[jnp.asarray(amask)]
+
+        # predicted boxes (normalized) for the ignore-region IoU test
+        gx = jnp.arange(w, dtype=xa.dtype)[None, None, :]
+        gy = jnp.arange(h, dtype=xa.dtype)[None, :, None]
+        pred_cx = (gx + sig(px)) / w
+        pred_cy = (gy + sig(py)) / h
+        pred_w = jnp.exp(pw) * aw[None, :, None, None] / in_w
+        pred_h = jnp.exp(ph) * ah[None, :, None, None] / in_h
+
+        B = gbox.shape[1]
+        gw = gbox[:, :, 2]
+        gh = gbox[:, :, 3]
+        valid_gt = (gw > 0) & (gh > 0)
+
+        def iou_cwh(cx1, cy1, w1, h1, cx2, cy2, w2, h2):
+            l1, r1 = cx1 - w1 / 2, cx1 + w1 / 2
+            t1, b1 = cy1 - h1 / 2, cy1 + h1 / 2
+            l2, r2 = cx2 - w2 / 2, cx2 + w2 / 2
+            t2, b2 = cy2 - h2 / 2, cy2 + h2 / 2
+            iw = jnp.maximum(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0)
+            ih = jnp.maximum(jnp.minimum(b1, b2) - jnp.maximum(t1, t2), 0)
+            inter = iw * ih
+            return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+        # max IoU of each prediction vs all gt: [N, mask, H, W]
+        ious = iou_cwh(
+            pred_cx[..., None], pred_cy[..., None],
+            pred_w[..., None], pred_h[..., None],
+            gbox[:, None, None, None, :, 0], gbox[:, None, None, None, :, 1],
+            gw[:, None, None, None, :], gh[:, None, None, None, :])
+        ious = jnp.where(valid_gt[:, None, None, None, :], ious, 0.0)
+        max_iou = jnp.max(ious, axis=-1)
+        noobj_mask = (max_iou <= ignore_thresh).astype(xa.dtype)
+
+        # per-gt assignment: best anchor over ALL anchors by shape IoU
+        shape_iou = iou_cwh(
+            0.0, 0.0, gw[..., None] * in_w, gh[..., None] * in_h,
+            0.0, 0.0, aw_all[None, None, :], ah_all[None, None, :])
+        best_a = jnp.argmax(shape_iou, axis=-1)  # [N, B]
+        # position in the anchor_mask (or -1 when not in this head's mask)
+        in_mask = jnp.full(best_a.shape, -1, jnp.int32)
+        for mi, m in enumerate(amask):
+            in_mask = jnp.where(best_a == m, mi, in_mask)
+        gi = jnp.clip((gbox[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gbox[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+        tx = gbox[:, :, 0] * w - gi
+        ty = gbox[:, :, 1] * h - gj
+        tw = jnp.log(jnp.maximum(gw * in_w, 1e-10)
+                     / jnp.maximum(aw_all[best_a], 1e-10))
+        th = jnp.log(jnp.maximum(gh * in_h, 1e-10)
+                     / jnp.maximum(ah_all[best_a], 1e-10))
+        scale = 2.0 - gw * gh
+        use = valid_gt & (in_mask >= 0)
+        sc = (gscore if gscore is not None
+              else jnp.ones(glabel.shape, xa.dtype))
+
+        smooth_pos = (1.0 - 1.0 / class_num if use_label_smooth
+                      and class_num > 1 else 1.0)
+        smooth_neg = (1.0 / class_num if use_label_smooth
+                      and class_num > 1 else 0.0)
+
+        bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, B))
+        mm = jnp.clip(in_mask, 0, mask_num - 1)
+        px_g = px[bidx, mm, gj, gi]
+        py_g = py[bidx, mm, gj, gi]
+        pw_g = pw[bidx, mm, gj, gi]
+        ph_g = ph[bidx, mm, gj, gi]
+        pcls_g = pcls[bidx, mm, :, gj, gi]  # [N, B, cls]
+        um = use.astype(xa.dtype) * sc
+        loss_xy = (bce(px_g, tx) + bce(py_g, ty)) * scale * um
+        loss_wh = (jnp.abs(pw_g - tw) + jnp.abs(ph_g - th)) * scale * um
+        onehot = jax.nn.one_hot(glabel.astype(jnp.int32), class_num,
+                                dtype=xa.dtype)
+        tcls = onehot * smooth_pos + (1 - onehot) * smooth_neg
+        loss_cls = jnp.sum(bce(pcls_g, tcls), axis=-1) * um
+
+        # objectness: positives at assigned cells, negatives elsewhere
+        # (ignored where max_iou > thresh)
+        obj_pos = jnp.zeros((n, mask_num, h, w), xa.dtype)
+        obj_pos = obj_pos.at[bidx, mm, gj, gi].max(um)
+        pos_here = obj_pos > 0
+        loss_obj = jnp.where(
+            pos_here, bce(pobj, 1.0) * obj_pos,
+            bce(pobj, 0.0) * noobj_mask)
+        return (jnp.sum(loss_xy + loss_wh + loss_cls, axis=1)
+                + jnp.sum(loss_obj, axis=(1, 2, 3)))
+
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None
+                                    else [])
+    return _trace(fn, args, "yolo_loss")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """Parity: prior_box_op.h — SSD prior boxes.
+    Returns (boxes [H, W, num_priors, 4], variances same shape)."""
+    min_sizes = ([float(min_sizes)] if np.isscalar(min_sizes)
+                 else [float(m) for m in min_sizes])
+    max_sizes = ([] if not max_sizes else
+                 ([float(max_sizes)] if np.isscalar(max_sizes)
+                  else [float(m) for m in max_sizes]))
+    in_ars = ([float(aspect_ratios)] if np.isscalar(aspect_ratios)
+              else [float(a) for a in aspect_ratios])
+    ars = [1.0]
+    for ar in in_ars:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    variance = [float(v) for v in variance]
+
+    def fn(feat, img):
+        import jax.numpy as jnp
+
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        step_w = float(steps[0]) or iw / fw
+        step_h = float(steps[1]) or ih / fh
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+        whs = []
+        for s, ms in enumerate(min_sizes):
+            if not min_max_aspect_ratios_order:
+                for ar in ars:
+                    whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                if max_sizes:
+                    m = np.sqrt(ms * max_sizes[s])
+                    whs.append((m, m))
+            else:
+                whs.append((ms, ms))
+                if max_sizes:
+                    m = np.sqrt(ms * max_sizes[s])
+                    whs.append((m, m))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        bw = jnp.asarray([v[0] for v in whs], jnp.float32) * 0.5
+        bh = jnp.asarray([v[1] for v in whs], jnp.float32) * 0.5
+        x1 = (cx[None, :, None] - bw[None, None, :]) / iw
+        y1 = (cy[:, None, None] - bh[None, None, :]) / ih
+        x2 = (cx[None, :, None] + bw[None, None, :]) / iw
+        y2 = (cy[:, None, None] + bh[None, None, :]) / ih
+        boxes = jnp.stack(
+            [jnp.broadcast_to(x1, (fh, fw, len(whs))),
+             jnp.broadcast_to(y1, (fh, fw, len(whs))),
+             jnp.broadcast_to(x2, (fh, fw, len(whs))),
+             jnp.broadcast_to(y2, (fh, fw, len(whs)))], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        vars_ = jnp.broadcast_to(
+            jnp.asarray(variance, jnp.float32), boxes.shape)
+        return boxes, vars_
+
+    return _trace(fn, [input, image], "prior_box")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    """Parity: box_coder_op.h — encode/decode between corner boxes and
+    center-size deltas."""
+    encode = code_type.lower() in ("encode_center_size", "encode")
+    var_is_tensor = not isinstance(prior_box_var, (list, tuple, type(None)))
+    var_list = (None if var_is_tensor
+                else ([float(v) for v in prior_box_var]
+                      if prior_box_var is not None else None))
+
+    def fn(pb, tb, *rest):
+        import jax.numpy as jnp
+
+        pv = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if var_list is not None:
+            v = jnp.asarray(var_list, pb.dtype)
+            v0, v1, v2, v3 = v[0], v[1], v[2], v[3]
+        elif pv is not None:
+            v0, v1, v2, v3 = pv[:, 0], pv[:, 1], pv[:, 2], pv[:, 3]
+        else:
+            v0 = v1 = v2 = v3 = jnp.asarray(1.0, pb.dtype)
+        if encode:
+            # tb [N, 4] gt; out [N, M, 4]
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / v0
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / v1
+            ow = jnp.log(tw[:, None] / pw[None, :]) / v2
+            oh = jnp.log(th[:, None] / ph[None, :]) / v3
+            return jnp.stack([ox, oy, ow, oh], axis=-1)
+        # decode: tb [N, M, 4] deltas; priors along ``axis``
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_ = (pcx[None, :], pcy[None, :],
+                                    pw[None, :], ph[None, :])
+            if var_list is None and pv is not None:
+                v0_, v1_, v2_, v3_ = (v0[None, :], v1[None, :],
+                                      v2[None, :], v3[None, :])
+            else:
+                v0_, v1_, v2_, v3_ = v0, v1, v2, v3
+        else:
+            pcx_, pcy_, pw_, ph_ = (pcx[:, None], pcy[:, None],
+                                    pw[:, None], ph[:, None])
+            if var_list is None and pv is not None:
+                v0_, v1_, v2_, v3_ = (v0[:, None], v1[:, None],
+                                      v2[:, None], v3[:, None])
+            else:
+                v0_, v1_, v2_, v3_ = v0, v1, v2, v3
+        ocx = v0_ * tb[:, :, 0] * pw_ + pcx_
+        ocy = v1_ * tb[:, :, 1] * ph_ + pcy_
+        ow = jnp.exp(v2_ * tb[:, :, 2]) * pw_
+        oh = jnp.exp(v3_ * tb[:, :, 3]) * ph_
+        return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                          ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm],
+                         axis=-1)
+
+    args = [prior_box, target_box] + ([prior_box_var] if var_is_tensor
+                                      else [])
+    return _trace(fn, args, "box_coder")
+
+
+def _iou_corner(a, b, normalized=True):
+    import jax.numpy as jnp
+
+    norm = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = a[..., 0], a[..., 1], a[..., 2], a[..., 3]
+    bx1, by1, bx2, by2 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    area_a = (ax2 - ax1 + norm) * (ay2 - ay1 + norm)
+    area_b = (bx2 - bx1 + norm) * (by2 - by1 + norm)
+    iw = jnp.maximum(
+        jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1) + norm, 0)
+    ih = jnp.maximum(
+        jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1) + norm, 0)
+    inter = iw * ih
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_index=False,
+                   rois_num=None):
+    """Parity: multiclass_nms_op.cc — per-class greedy NMS then cross-class
+    top-k.  LoD adaptation: returns (out [N, keep_top_k, 6] padded with
+    -1 rows, nms_num [N]) — and optionally the flat candidate indices.
+
+    bboxes [N, M, 4]; scores [N, C, M].
+    """
+
+    def fn(bb, sc):
+        import jax
+        import jax.numpy as jnp
+
+        n, m, _ = bb.shape
+        c = sc.shape[1]
+        k = min(int(nms_top_k) if nms_top_k > 0 else m, m)
+        keep_k = int(keep_top_k) if keep_top_k > 0 else k * c
+
+        def per_class(boxes, cls_scores):
+            # top-k candidates by score
+            s_top, idx = jax.lax.top_k(cls_scores, k)
+            b_top = boxes[idx]
+            iou = _iou_corner(b_top[:, None, :], b_top[None, :, :],
+                              normalized)
+            ok0 = s_top > score_threshold
+
+            def body(i, carry):
+                # suppressed if any earlier KEPT box overlaps > the
+                # (adaptively decayed — nms_eta) current threshold
+                keep, th = carry
+                over = (iou[i] > th) & keep
+                sup = jnp.any(over & (jnp.arange(k) < i))
+                kept = ok0[i] & ~sup
+                th = jnp.where(kept & (th > 0.5) & (nms_eta < 1.0),
+                               th * nms_eta, th)
+                return keep.at[i].set(kept), th
+
+            keep, _ = jax.lax.fori_loop(
+                0, k, body, (jnp.zeros((k,), bool),
+                             jnp.asarray(nms_threshold, jnp.float32)))
+            return s_top, idx, keep
+
+        def per_image(boxes, img_scores):
+            ss, ii, kk = jax.vmap(
+                lambda cs: per_class(boxes, cs))(img_scores)
+            # drop background class
+            if 0 <= background_label < c:
+                kk = kk.at[background_label].set(
+                    jnp.zeros_like(kk[background_label]))
+            cls_id = jnp.broadcast_to(
+                jnp.arange(c)[:, None], (c, k))
+            flat_s = jnp.where(kk, ss, -1.0).reshape(-1)
+            flat_i = ii.reshape(-1)
+            flat_c = cls_id.reshape(-1)
+            s_sel, order = jax.lax.top_k(flat_s, min(keep_k, flat_s.size))
+            sel_i = flat_i[order]
+            sel_c = flat_c[order]
+            valid = s_sel > -1.0
+            out = jnp.stack(
+                [jnp.where(valid, sel_c.astype(boxes.dtype), -1.0),
+                 jnp.where(valid, s_sel, -1.0),
+                 jnp.where(valid, boxes[sel_i, 0], -1.0),
+                 jnp.where(valid, boxes[sel_i, 1], -1.0),
+                 jnp.where(valid, boxes[sel_i, 2], -1.0),
+                 jnp.where(valid, boxes[sel_i, 3], -1.0)], axis=-1)
+            index = jnp.where(valid, sel_i, -1)
+            return out, jnp.sum(valid.astype(jnp.int32)), index
+
+        outs, nums, indices = jax.vmap(per_image)(bb, sc)
+        return outs, nums, indices
+
+    out, nums, idx = _trace(fn, [bboxes, scores], "multiclass_nms")
+    if return_index:
+        return out, nums, idx
+    return out, nums
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=(1, 1),
+              spatial_scale=1.0, sampling_ratio=-1, aligned=True,
+              name=None, batch_indices=None):
+    """Parity: roi_align_op — average of bilinear samples per output bin.
+
+    x [N, C, H, W]; boxes [R, 4] (x1, y1, x2, y2); box-to-image mapping
+    via ``boxes_num`` [N] (reference 2.x API) or explicit
+    ``batch_indices`` [R].  ``sampling_ratio=-1`` uses the adaptive
+    ceil(roi_size / bin) rule at trace time via a fixed 2-sample grid
+    (static shapes; documented deviation)."""
+    ph, pw = ((output_size, output_size) if np.isscalar(output_size)
+              else tuple(output_size))
+    sr = int(sampling_ratio) if int(sampling_ratio) > 0 else 2
+    # which mapping was supplied is known HERE — never inferred from
+    # shapes (boxes_num [N] are per-image counts; batch_indices [R] are
+    # explicit per-roi image ids)
+    rest_is_counts = boxes_num is not None
+
+    def fn(xa, bx, *rest):
+        import jax
+        import jax.numpy as jnp
+
+        n, ch, h, w = xa.shape
+        r = bx.shape[0]
+        if rest:
+            bn = rest[0].astype(jnp.int32).reshape(-1)
+            if rest_is_counts:  # boxes_num -> batch index per roi
+                ends = jnp.cumsum(bn)
+                bidx = jnp.sum(
+                    (jnp.arange(r)[:, None] >= ends[None, :]).astype(
+                        jnp.int32), axis=1)
+            else:
+                bidx = bn
+        else:
+            bidx = jnp.zeros((r,), jnp.int32)
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: [ph|pw, sr] offsets within the roi
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        # positions: [R, ph, sr]
+        sy = y1[:, None, None] + iy[None] * bin_h[:, None, None]
+        sx = x1[:, None, None] + ix[None] * bin_w[:, None, None]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [ph*sr], xx [pw*sr] -> [C, ph*sr, pw*sr]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            out = 0.0
+            for oy in (0, 1):
+                for ox in (0, 1):
+                    yc = y0 + oy
+                    xc = x0 + ox
+                    vy = (yy >= -1.0) & (yc >= 0) & (yc <= h - 1)
+                    vx = (xx >= -1.0) & (xc >= 0) & (xc <= w - 1)
+                    yi = jnp.clip(yc, 0, h - 1).astype(jnp.int32)
+                    xi = jnp.clip(xc, 0, w - 1).astype(jnp.int32)
+                    wy = jnp.where(oy, yy - y0, 1 - (yy - y0)) * vy
+                    wx = jnp.where(ox, xx - x0, 1 - (xx - x0)) * vx
+                    g = img[:, yi][:, :, xi]
+                    out = out + g * (wy[None, :, None] * wx[None, None, :])
+            return out
+
+        def per_roi(b, yy, xx):
+            img = xa[b]
+            g = bilinear(img, yy.reshape(-1), xx.reshape(-1))
+            g = g.reshape(ch, ph, sr, pw, sr)
+            return jnp.mean(g, axis=(2, 4))
+
+        return jax.vmap(per_roi)(bidx, sy, sx)
+
+    extra = ([boxes_num] if boxes_num is not None
+             else ([batch_indices] if batch_indices is not None else []))
+    return _trace(fn, [x, boxes] + extra, "roi_align")
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """Parity: generate_proposals_op — RPN: decode anchors by deltas,
+    clip to image, filter small, NMS.  Dense outputs padded with zeros +
+    count (LoD adaptation)."""
+
+    def fn(sc, deltas, imgs, anc, var):
+        import jax
+        import jax.numpy as jnp
+
+        n, a4, h, w = deltas.shape
+        a = a4 // 4
+        m = a * h * w
+        anc_f = anc.reshape(-1, 4)
+        var_f = var.reshape(-1, 4)
+        sc_f = jnp.moveaxis(sc.reshape(n, a, h, w), 1, -1).reshape(n, m)
+        dl = jnp.moveaxis(deltas.reshape(n, a, 4, h, w), (1, 2), (2, 3))
+        dl = dl.reshape(n, m, 4)
+
+        pw = anc_f[:, 2] - anc_f[:, 0] + 1.0
+        phh = anc_f[:, 3] - anc_f[:, 1] + 1.0
+        pcx = anc_f[:, 0] + pw * 0.5
+        pcy = anc_f[:, 1] + phh * 0.5
+
+        def per_image(s, d, im):
+            ocx = var_f[:, 0] * d[:, 0] * pw + pcx
+            ocy = var_f[:, 1] * d[:, 1] * phh + pcy
+            ow = jnp.exp(jnp.minimum(var_f[:, 2] * d[:, 2],
+                                     np.log(1000. / 16.))) * pw
+            oh = jnp.exp(jnp.minimum(var_f[:, 3] * d[:, 3],
+                                     np.log(1000. / 16.))) * phh
+            x1 = jnp.clip(ocx - ow * 0.5, 0, im[1] - 1)
+            y1 = jnp.clip(ocy - oh * 0.5, 0, im[0] - 1)
+            x2 = jnp.clip(ocx + ow * 0.5, 0, im[1] - 1)
+            y2 = jnp.clip(ocy + oh * 0.5, 0, im[0] - 1)
+            keep_sz = ((x2 - x1 + 1) >= min_size) & ((y2 - y1 + 1)
+                                                     >= min_size)
+            s2 = jnp.where(keep_sz, s, -1e10)
+            k = min(int(pre_nms_top_n), m)
+            s_top, idx = jax.lax.top_k(s2, k)
+            boxes = jnp.stack([x1, y1, x2, y2], -1)[idx]
+            iou = _iou_corner(boxes[:, None], boxes[None, :],
+                              normalized=False)
+            ok0 = s_top > -1e9
+
+            def body(i, keep):
+                over = (iou[i] > nms_thresh) & keep
+                sup = jnp.any(over & (jnp.arange(k) < i))
+                return keep.at[i].set(ok0[i] & ~sup)
+
+            keep = jax.lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+            s_keep = jnp.where(keep, s_top, -1e10)
+            kk = min(int(post_nms_top_n), k)
+            s_fin, order = jax.lax.top_k(s_keep, kk)
+            valid = s_fin > -1e9
+            out = boxes[order] * valid[:, None]
+            return out, s_fin * valid, jnp.sum(valid.astype(jnp.int32))
+
+        rois, rscores, num = jax.vmap(per_image)(sc_f, dl, imgs)
+        return rois, rscores, num
+
+    rois, rscores, num = _trace(
+        fn, [scores, bbox_deltas, img_size, anchors, variances],
+        "generate_proposals")
+    if return_rois_num:
+        return rois, rscores, num
+    return rois, rscores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Parity: distribute_fpn_proposals_op — route each RoI to an FPN
+    level by scale.  Dense adaptation: returns per-level masks instead of
+    compacted lists (shapes stay static)."""
+
+    def fn(rois):
+        import jax.numpy as jnp
+
+        w = rois[:, 2] - rois[:, 0]
+        h = rois[:, 3] - rois[:, 1]
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        return lvl
+
+    return _trace(fn, [fpn_rois], "distribute_fpn_proposals")
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,

@@ -1,0 +1,310 @@
+"""Detection op kit vs numpy references.
+
+Parity targets: ``/root/reference/paddle/fluid/operators/detection/``
+(prior_box_op.h, box_coder_op.h, yolo_box_op.h, yolov3_loss_op.h,
+multiclass_nms_op.cc) and ``roi_align_op``; surfaces
+``python/paddle/fluid/layers/detection.py`` + ``python/paddle/vision/ops.py``.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_prior_box_matches_ssd_reference():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 3), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 10, 12), "float32"))
+    boxes, vars_ = vops.prior_box(
+        feat, img, min_sizes=[4.0], max_sizes=[8.0], aspect_ratios=[2.0],
+        flip=True, clip=True, variance=[0.1, 0.1, 0.2, 0.2])
+    b = _np(boxes)
+    v = _np(vars_)
+    # num_priors: ars {1, 2, 0.5} = 3, + 1 max-size box = 4
+    assert b.shape == (2, 3, 4, 4)
+    assert v.shape == b.shape
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # cell (0, 0): center = (0.5*step_w, 0.5*step_h) = (2.0, 2.5)
+    # first prior: ar=1, size 4 -> half extents 2/12, 2/10
+    cx, cy = 2.0, 2.5
+    exp = [max((cx - 2) / 12, 0), max((cy - 2) / 10, 0),
+           (cx + 2) / 12, (cy + 2) / 10]
+    np.testing.assert_allclose(b[0, 0, 0], exp, rtol=1e-5)
+    # max-size prior is sqrt(4*8) square, appended after the ars
+    s = np.sqrt(32.0) / 2
+    exp_max = [max((cx - s) / 12, 0), max((cy - s) / 10, 0),
+               (cx + s) / 12, (cy + s) / 10]
+    np.testing.assert_allclose(b[0, 0, 3], exp_max, rtol=1e-5)
+    assert (b >= 0).all() and (b <= 1).all()  # clip
+
+
+def test_box_coder_decode_encode_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = np.array([[0.1, 0.1, 0.5, 0.5],
+                       [0.2, 0.3, 0.7, 0.9]], "float32")
+    var = [0.1, 0.1, 0.2, 0.2]
+    gt = np.array([[0.15, 0.2, 0.6, 0.7]], "float32")
+    enc = vops.box_coder(paddle.to_tensor(priors), var,
+                         paddle.to_tensor(gt),
+                         code_type="encode_center_size")
+    e = _np(enc)  # [1, 2, 4]
+    # numpy reference for prior 0
+    pw, ph = 0.4, 0.4
+    pcx, pcy = 0.3, 0.3
+    gw, gh = 0.45, 0.5
+    gcx, gcy = 0.375, 0.45
+    ref = [(gcx - pcx) / pw / 0.1, (gcy - pcy) / ph / 0.1,
+           np.log(gw / pw) / 0.2, np.log(gh / ph) / 0.2]
+    np.testing.assert_allclose(e[0, 0], ref, rtol=1e-5)
+    # decode(encode) returns the gt box for every prior
+    dec = vops.box_coder(paddle.to_tensor(priors), var,
+                         paddle.to_tensor(e),
+                         code_type="decode_center_size")
+    d = _np(dec)
+    for m in range(2):
+        np.testing.assert_allclose(d[0, m], gt[0], rtol=1e-4, atol=1e-5)
+
+
+def test_yolo_box_formulas():
+    an = [10, 13, 16, 30]  # 2 anchors
+    cls = 3
+    h = w = 2
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2 * (5 + cls), h, w).astype("float32")
+    img = np.array([[64, 64]], "int32")
+    boxes, scores = vops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), an, cls,
+        conf_thresh=0.0, downsample_ratio=32, clip_bbox=False)
+    b = _np(boxes)
+    s = _np(scores)
+    assert b.shape == (1, 8, 4) and s.shape == (1, 8, cls)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    xa = x.reshape(1, 2, 5 + cls, h, w)
+    # anchor 0, cell (row 1, col 0) -> flat index 0*4 + 1*2 + 0 = 2
+    tx, ty, tw, th = xa[0, 0, 0, 1, 0], xa[0, 0, 1, 1, 0], \
+        xa[0, 0, 2, 1, 0], xa[0, 0, 3, 1, 0]
+    cx = (0 + sig(tx)) / w * 64
+    cy = (1 + sig(ty)) / h * 64
+    bw = np.exp(tw) * 10 * 64 / (32 * w)
+    bh = np.exp(th) * 13 * 64 / (32 * h)
+    ref = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+    np.testing.assert_allclose(b[0, 2], ref, rtol=1e-4)
+    conf = sig(xa[0, 0, 4, 1, 0])
+    np.testing.assert_allclose(
+        s[0, 2], conf * sig(xa[0, 0, 5:, 1, 0]), rtol=1e-4)
+
+
+def test_yolo_box_conf_threshold_zeroes():
+    an = [10, 13]
+    x = np.full((1, 1 * 8, 2, 2), -5.0, "float32")  # conf ~ 0.007
+    img = np.array([[64, 64]], "int32")
+    boxes, scores = vops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), an, 3,
+        conf_thresh=0.5, downsample_ratio=32)
+    assert np.allclose(_np(boxes), 0)
+    assert np.allclose(_np(scores), 0)
+
+
+def test_yolo_loss_finite_and_responds_to_targets():
+    an = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    cls = 4
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3 * (5 + cls), 4, 4).astype("float32") * 0.1
+    gt = np.zeros((2, 3, 4), "float32")
+    gt[0, 0] = [0.5, 0.5, 0.2, 0.3]  # one real box
+    lbl = np.zeros((2, 3), "int64")
+    loss = vops.yolo_loss(
+        paddle.to_tensor(x), paddle.to_tensor(gt), paddle.to_tensor(lbl),
+        an, mask, cls, ignore_thresh=0.7, downsample_ratio=8)
+    lv = _np(loss)
+    assert lv.shape == (2,)
+    assert np.isfinite(lv).all() and (lv > 0).all()
+    # the image with a gt box pays location+class loss -> larger
+    assert lv[0] > lv[1]
+    # gradient flows to the predictions
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    loss2 = vops.yolo_loss(xt, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                           an, mask, cls, ignore_thresh=0.7,
+                           downsample_ratio=8)
+    loss2.sum().backward()
+    g = np.asarray(xt.grad.numpy())
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        ok = True
+        for j in keep:
+            # IoU
+            x1 = max(boxes[i, 0], boxes[j, 0])
+            y1 = max(boxes[i, 1], boxes[j, 1])
+            x2 = min(boxes[i, 2], boxes[j, 2])
+            y2 = min(boxes[i, 3], boxes[j, 3])
+            iw, ih = max(x2 - x1, 0), max(y2 - y1, 0)
+            inter = iw * ih
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a1 + a2 - inter) > thr:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def test_multiclass_nms_vs_numpy():
+    boxes = np.array([
+        [0.0, 0.0, 0.4, 0.4],
+        [0.05, 0.05, 0.45, 0.45],   # overlaps box 0
+        [0.6, 0.6, 0.9, 0.9],
+        [0.0, 0.5, 0.3, 0.9],
+    ], "float32")[None]
+    # class 0 = background; class 1 scores
+    scores = np.zeros((1, 2, 4), "float32")
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]
+    out, nums = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, nms_top_k=4, keep_top_k=4,
+        nms_threshold=0.5, background_label=0)
+    o = _np(out)
+    n = int(_np(nums)[0])
+    keep = _np_nms(boxes[0], scores[0, 1] * (scores[0, 1] > 0.1), 0.5)
+    keep = [k for k in keep if scores[0, 1, k] > 0.1]
+    assert n == len(keep) == 2  # box 1 suppressed by 0; box 3 below thresh
+    np.testing.assert_allclose(o[0, 0], [1, 0.9, 0, 0, 0.4, 0.4],
+                               rtol=1e-5)
+    np.testing.assert_allclose(o[0, 1], [1, 0.7, 0.6, 0.6, 0.9, 0.9],
+                               rtol=1e-5)
+    assert np.allclose(o[0, n:], -1)  # padded rows
+
+
+def test_roi_align_single_pixel_bins():
+    # x is a 1x1x4x4 ramp; a roi covering exactly cell centers
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    # roi from (0.5, 0.5) to (2.5, 2.5) in input coords, aligned=True
+    rois = np.array([[0.5, 0.5, 2.5, 2.5]], "float32")
+    out = vops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        boxes_num=paddle.to_tensor(np.array([1], "int32")),
+        output_size=(2, 2), spatial_scale=1.0, sampling_ratio=1,
+        aligned=True)
+    o = _np(out)
+    assert o.shape == (1, 1, 2, 2)
+    # bin centers: (0.5, 0.5)+bin/2 etc -> sample at (0.5, 0.5) ... with
+    # aligned offset -0.5 the first sample sits at exactly pixel (0.5,0.5)
+    # numpy reference via direct bilinear evaluation
+    def bilin(y, xx):
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        wy, wx = y - y0, xx - x0
+        def at(r, c):
+            if 0 <= r < 4 and 0 <= c < 4:
+                return x[0, 0, r, c]
+            return 0.0
+        return (at(y0, x0) * (1 - wy) * (1 - wx)
+                + at(y0, x0 + 1) * (1 - wy) * wx
+                + at(y0 + 1, x0) * wy * (1 - wx)
+                + at(y0 + 1, x0 + 1) * wy * wx)
+
+    x1 = y1 = 0.5 - 0.5
+    bin_sz = 2.0 / 2
+    ref = np.zeros((2, 2))
+    for i in range(2):
+        for j in range(2):
+            ref[i, j] = bilin(y1 + (i + 0.5) * bin_sz,
+                              x1 + (j + 0.5) * bin_sz)
+    np.testing.assert_allclose(o[0, 0], ref, rtol=1e-5)
+
+
+def test_roi_align_batch_routing():
+    x = np.stack([np.zeros((1, 4, 4), "float32"),
+                  np.ones((1, 4, 4), "float32")])  # [2, 1, 4, 4]
+    rois = np.array([[0, 0, 2, 2], [0, 0, 2, 2]], "float32")
+    out = vops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        boxes_num=paddle.to_tensor(np.array([1, 1], "int32")),
+        output_size=1, spatial_scale=1.0, sampling_ratio=2, aligned=False)
+    o = _np(out)
+    assert abs(o[0, 0, 0, 0]) < 1e-6      # from image 0 (zeros)
+    assert abs(o[1, 0, 0, 0] - 1) < 1e-6  # from image 1 (ones)
+
+
+def test_generate_proposals_shapes_and_nms():
+    rng = np.random.RandomState(3)
+    h = w = 4
+    a = 3
+    scores = rng.rand(1, a, h, w).astype("float32")
+    deltas = (rng.randn(1, a * 4, h, w) * 0.1).astype("float32")
+    anchors = rng.rand(h, w, a, 4).astype("float32") * 8
+    anchors[..., 2:] += 8  # ensure x2 > x1
+    variances = np.full((h, w, a, 4), 0.1, "float32")
+    img = np.array([[32.0, 32.0]], "float32")
+    rois, rscores, num = vops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img), paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), pre_nms_top_n=20, post_nms_top_n=5,
+        nms_thresh=0.6, min_size=1.0, return_rois_num=True)
+    r = _np(rois)
+    n = int(_np(num)[0])
+    assert r.shape == (1, 5, 4)
+    assert 1 <= n <= 5
+    valid = r[0, :n]
+    assert (valid[:, 2] >= valid[:, 0]).all()
+    assert (valid >= 0).all() and (valid <= 31).all()
+
+
+def test_vision_ops_surface():
+    for name in ("yolo_loss", "yolo_box", "prior_box", "box_coder",
+                 "multiclass_nms", "roi_align", "deform_conv2d"):
+        assert hasattr(vops, name)
+    import paddle_tpu.static.nn as snn
+
+    for name in ("conv2d", "batch_norm", "layer_norm", "embedding",
+                 "sequence_pool", "multi_box_head"):
+        assert hasattr(snn, name)
+
+
+def test_roi_align_explicit_batch_indices():
+    """Advisor-fix regression: batch_indices must never be reinterpreted
+    as per-image counts (even when R == N)."""
+    x = np.stack([np.zeros((1, 4, 4), "float32"),
+                  np.ones((1, 4, 4), "float32")])
+    rois = np.array([[0, 0, 2, 2], [0, 0, 2, 2]], "float32")
+    out = vops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        batch_indices=paddle.to_tensor(np.array([0, 1], "int32")),
+        output_size=1, spatial_scale=1.0, sampling_ratio=2, aligned=False)
+    o = _np(out)
+    assert abs(o[0, 0, 0, 0]) < 1e-6
+    assert abs(o[1, 0, 0, 0] - 1) < 1e-6
+
+
+def test_multiclass_nms_eta_decays_threshold():
+    # two overlapping pairs; with eta decay the threshold drops below the
+    # pair IoU after the first keep, suppressing the second pair member
+    boxes = np.array([
+        [0.0, 0.0, 0.4, 0.4],
+        [0.1, 0.1, 0.5, 0.5],    # IoU with box 0 ~ 0.29
+    ], "float32")[None]
+    scores = np.zeros((1, 2, 2), "float32")
+    scores[0, 1] = [0.9, 0.8]
+    kw = dict(score_threshold=0.1, nms_top_k=2, keep_top_k=2,
+              nms_threshold=0.6, background_label=0)
+    _, n_plain = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores), nms_eta=1.0,
+        **kw)
+    _, n_eta = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores), nms_eta=0.4,
+        **kw)
+    assert int(_np(n_plain)[0]) == 2      # 0.29 < 0.6: both kept
+    assert int(_np(n_eta)[0]) == 1        # threshold decayed to 0.24
