@@ -103,3 +103,36 @@ class ElasticManager:
         if not os.listdir(self.store):
             return ElasticStatus.COMPLETED
         return ElasticStatus.HOLD
+
+    def start_beat_thread(self, interval: Optional[float] = None):
+        """Heartbeat from a daemon thread (the reference keeps an etcd
+        lease alive the same way).  Returns the thread."""
+        import threading
+
+        iv = interval if interval is not None else max(self.timeout / 5, 0.2)
+        self.register()
+
+        def loop():
+            while not self._stop_beat.is_set():
+                self.beat()
+                self._stop_beat.wait(iv)
+
+        self._stop_beat = threading.Event()
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._beat_thread = t
+        return t
+
+    def stop_beat_thread(self):
+        ev = getattr(self, "_stop_beat", None)
+        if ev is not None:
+            ev.set()
+
+    def clear(self):
+        """Reset the membership store (launcher does this before each
+        (re)start so stale heartbeats don't trigger an immediate restart)."""
+        for name in os.listdir(self.store):
+            try:
+                os.remove(os.path.join(self.store, name))
+            except OSError:
+                pass

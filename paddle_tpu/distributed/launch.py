@@ -47,6 +47,14 @@ def _parse_args(argv=None):
                    help="write per-rank workerlog.N files here")
     p.add_argument("--timeout", type=float, default=None,
                    help="seconds to wait before killing trainers")
+    p.add_argument("--elastic", action="store_true",
+                   help="restart-the-world on rank failure / stale "
+                        "heartbeat, resuming via auto_checkpoint")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="elastic: restart budget before giving up")
+    p.add_argument("--job_id", type=str, default=None,
+                   help="elastic: stable job id for checkpoint resume "
+                        "(exported as PADDLE_JOB_ID)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -84,11 +92,22 @@ def launch(argv=None) -> int:
     args = _parse_args(argv)
     cluster, devices = get_cluster_from_args(args)
     cmd = [sys.executable, args.training_script] + args.training_script_args
-    procs = start_local_trainers(cluster, cmd, base_env=dict(os.environ),
-                                 log_dir=args.log_dir, devices=devices)
+    base_env = dict(os.environ)
+    if args.job_id:
+        base_env["PADDLE_JOB_ID"] = args.job_id
     print(f"launch: {cluster.nproc_per_node} local trainer(s), world size "
-          f"{cluster.world_size}, master {cluster.master}:{cluster.master_port}",
+          f"{cluster.world_size}, master {cluster.master}:{cluster.master_port}"
+          + (" [elastic]" if args.elastic else ""),
           flush=True)
+    if args.elastic or os.environ.get("PADDLE_ELASTIC_STORE"):
+        from .launch_utils import run_elastic
+
+        return run_elastic(cluster, cmd, base_env=base_env,
+                           log_dir=args.log_dir, devices=devices,
+                           max_restarts=args.max_restarts,
+                           timeout=args.timeout)
+    procs = start_local_trainers(cluster, cmd, base_env=base_env,
+                                 log_dir=args.log_dir, devices=devices)
     return watch_local_trainers(procs, timeout=args.timeout)
 
 

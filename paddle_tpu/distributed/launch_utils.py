@@ -153,3 +153,102 @@ def watch_local_trainers(procs: List[TrainerProc], timeout: Optional[float]
             if t.log_file:
                 t.log_file.close()
     return rc
+
+
+def watch_local_trainers_elastic(procs: List[TrainerProc], manager,
+                                 timeout: Optional[float] = None) -> int:
+    """watch_local_trainers + the ElasticManager watchdog (the reference's
+    ``elastic.py:171-204`` watch loop fused with ``launch_utils.py:73``
+    ``_check_procs``): besides process exits, a rank whose heartbeat goes
+    stale (hung, not crashed) also fails the round.  Returns the exit
+    code; callers decide whether to restart the world."""
+    from .fleet.elastic import ElasticStatus
+
+    deadline = time.time() + timeout if timeout else None
+    alive = {t.rank: t for t in procs}
+    rc = 0
+    try:
+        while alive:
+            for rank, t in list(alive.items()):
+                code = t.proc.poll()
+                if code is None:
+                    continue
+                del alive[rank]
+                if code != 0:
+                    sys.stderr.write(
+                        f"elastic: trainer {rank} exited with code {code}"
+                        + (f" (log: {t.log_path})" if t.log_path else "")
+                        + "\n")
+                    rc = rc or code
+            if alive and rc:
+                break  # crash: stop the round, kill the rest
+            status = manager.watch()
+            if status == ElasticStatus.RESTART and alive:
+                stale = manager.failed_ranks()
+                sys.stderr.write(
+                    f"elastic: stale heartbeat from rank(s) {stale} — "
+                    f"restarting the world\n")
+                rc = rc or 99  # heartbeat-timeout code
+                break
+            if deadline and time.time() > deadline:
+                sys.stderr.write("elastic: round timeout\n")
+                rc = rc or 124
+                break
+            time.sleep(0.2)
+    finally:
+        for t in alive.values():
+            try:
+                t.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for t in alive.values():
+            try:
+                # short grace: restart-the-world wants the round torn down
+                # promptly (jax's preemption notifier swallows SIGTERM in
+                # trainers that don't install their own handler)
+                t.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                t.proc.kill()
+        for t in procs:
+            if t.log_file:
+                t.log_file.close()
+    return rc
+
+
+def run_elastic(cluster: Cluster, cmd: List[str],
+                base_env: Optional[Dict[str, str]] = None,
+                log_dir: Optional[str] = None,
+                devices: Optional[List[str]] = None,
+                max_restarts: int = 3,
+                timeout: Optional[float] = None) -> int:
+    """Restart-the-world elastic loop (reference ElasticManager semantics:
+    any rank failing ends the round; the whole job relaunches and resumes
+    from the auto_checkpoint state under the same PADDLE_JOB_ID)."""
+    from .fleet.elastic import ElasticManager
+
+    env = dict(base_env if base_env is not None else os.environ)
+    store = env.setdefault(
+        "PADDLE_ELASTIC_STORE",
+        os.path.join(log_dir or "/tmp", "paddle_tpu_elastic_store"))
+    manager = ElasticManager(store_dir=store, rank=-1,
+                             world_size=cluster.world_size)
+    restarts = 0
+    while True:
+        manager.clear()
+        attempt_log = (os.path.join(log_dir, f"attempt_{restarts}")
+                       if log_dir else None)
+        procs = start_local_trainers(cluster, cmd, base_env=env,
+                                     log_dir=attempt_log, devices=devices)
+        rc = watch_local_trainers_elastic(procs, manager, timeout=timeout)
+        if rc == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            sys.stderr.write(
+                f"elastic: giving up after {max_restarts} restart(s), "
+                f"rc={rc}\n")
+            return rc
+        sys.stderr.write(
+            f"elastic: restarting the world (attempt {restarts}/"
+            f"{max_restarts})\n")
+        time.sleep(1.0)

@@ -1,0 +1,80 @@
+"""Elastic integration trainer (run by test_elastic.py via the launcher).
+
+2-rank job: rendezvous, heartbeat thread, dygraph training wrapped in
+``auto_checkpoint.train_epoch_range``.  Rank 1 kills itself ONCE at
+ELASTIC_FAIL_EPOCH (flag file marks the injection as done) — the elastic
+launcher must restart the world and training must resume from the
+checkpointed epoch, not from scratch."""
+
+import json
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in flags.split() if "host_platform_device_count" not in f)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.distributed import parallel  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import ElasticManager  # noqa: E402
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.incubate import auto_checkpoint as acp  # noqa: E402
+
+env = parallel.init_parallel_env()
+rank, ws = env.rank, env.world_size
+assert ws == 2, f"world_size {ws}"
+
+# elastic workers terminate promptly on the launcher's SIGTERM (jax installs
+# a preemption notifier that merely LOGS the signal — restart-the-world
+# wants the rank gone, the checkpoint already persists the state)
+import signal  # noqa: E402
+
+signal.signal(signal.SIGTERM, lambda *_: os._exit(143))
+
+manager = ElasticManager()
+manager.start_beat_thread()
+
+fail_epoch = int(os.environ.get("ELASTIC_FAIL_EPOCH", "-1"))
+flag_path = os.environ.get("ELASTIC_FAIL_FLAG", "")
+run_log = os.environ.get("ELASTIC_RUN_LOG", "")
+
+paddle.seed(0)
+model = nn.Linear(4, 1)
+opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+acp.register(model, opt)
+
+rng = np.random.RandomState(42)
+xs = rng.randn(16, 4).astype("float32")
+ys = (xs @ np.array([1.0, -2.0, 0.5, 3.0], "float32"))[:, None]
+
+import time  # noqa: E402
+
+for epoch in acp.train_epoch_range(6, save_checkpoint_inter=0):
+    # one-time failure injection BEFORE training the epoch
+    if (rank == 1 and epoch == fail_epoch and flag_path
+            and not os.path.exists(flag_path)):
+        with open(flag_path, "w") as f:
+            f.write("injected")
+        os._exit(7)
+    losses = []
+    for _ in range(5):
+        pred = model(paddle.to_tensor(xs))
+        loss = ((pred - paddle.to_tensor(ys)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    if run_log and rank == 0:
+        with open(f"{run_log}.rank0", "a") as f:
+            f.write(json.dumps({"pid": os.getpid(), "epoch": epoch,
+                                "loss": losses[0]}) + "\n")
+    time.sleep(0.2)
+
+manager.exit()
+print(f"rank {rank} done", flush=True)
