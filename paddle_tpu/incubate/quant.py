@@ -26,16 +26,18 @@ __all__ = ["ImperativeQuantAware", "ImperativePTQ", "QuantizedLinear",
 
 def _fake_quant(x, kind: str, bits: int, layer, state_name: str,
                 moving_rate: float = 0.9):
-    from ..dygraph import tracer
     from ..dygraph.tensor import Tensor
+    from ..framework import program as fw
+    from ..ops.dispatch import dispatch
 
+    ndim = len(x.shape)
     if kind == "channel":
-        outs = tracer.trace_op(
+        outs = dispatch(
             "fake_channel_wise_quantize_dequantize_abs_max", {"X": [x]},
-            {"bit_length": bits, "quant_axis": x.ndim - 1})
+            {"bit_length": bits, "quant_axis": ndim - 1})
         return outs["Out"][0]
     if kind == "abs_max":
-        outs = tracer.trace_op(
+        outs = dispatch(
             "fake_quantize_dequantize_abs_max", {"X": [x]},
             {"bit_length": bits})
         return outs["Out"][0]
@@ -44,16 +46,31 @@ def _fake_quant(x, kind: str, bits: int, layer, state_name: str,
     # would silently drop it on save/load)
     scale = getattr(layer, state_name, None)
     if scale is None:
+        if not fw.in_dygraph_mode():
+            raise RuntimeError(
+                "fake-quant scale buffer missing under a static trace — "
+                "calibrate/train the quantized model eagerly before "
+                "jit.save / to_static")
         scale = Tensor(np.asarray([float(np.abs(np.asarray(x._array)).max()
                                          or 1.0)], "float32"),
                        stop_gradient=True)
         layer.register_buffer(state_name, scale)
-    outs = tracer.trace_op(
+    sc_in = scale
+    if not fw.in_dygraph_mode():
+        # static trace: address the buffer through its bound program var
+        # (jit._bind_params created it and pushed the value to the scope)
+        blk = fw.default_main_program().global_block()
+        v = blk.vars.get(scale.name)
+        if v is None:
+            v = blk.create_var(name=scale.name, shape=(1,),
+                               dtype="float32", persistable=True)
+        sc_in = v
+    outs = dispatch(
         "fake_quantize_dequantize_moving_average_abs_max",
-        {"X": [x], "InScale": [scale]},
+        {"X": [x], "InScale": [sc_in]},
         {"bit_length": bits, "moving_rate": moving_rate,
          "is_test": not layer.training})
-    if layer.training:
+    if layer.training and fw.in_dygraph_mode():
         scale._array = outs["OutScale"][0]._array
     return outs["Out"][0]
 

@@ -97,16 +97,25 @@ class Config:
 
     def enable_tensorrt_engine(self, *a, precision_mode=PrecisionType.Float32,
                                **k):
-        # TRT role ≙ XLA fusion; bf16 precision maps to an AMP rewrite
+        # TRT role ≙ XLA fusion; bf16 precision maps to an AMP rewrite,
+        # int8 to the quantized-matmul program rewrite
         self._amp = ("bfloat16" if precision_mode in
                      (PrecisionType.Half, PrecisionType.Bfloat16) else None)
+        if precision_mode == PrecisionType.Int8:
+            self._int8 = True
 
     def enable_bf16(self):
         self._amp = "bfloat16"
 
+    def enable_int8(self):
+        """Execute weight matmuls as int8 x int8 -> int32 on the MXU
+        (static/quant_int8.py rewrite; the TRT int8 engine role)."""
+        self._int8 = True
+
     def summary(self):
         return {"model": self._prefix, "device": self._device,
-                "amp": self._amp, **self._opts}
+                "amp": self._amp, "int8": getattr(self, "_int8", False),
+                **self._opts}
 
 
 class Tensor:
@@ -159,6 +168,12 @@ class Predictor:
             from ..static.amp import rewrite_program
 
             rewrite_program(self._program)
+        if getattr(config, "_int8", False):
+            from ..static.quant_int8 import rewrite_program_int8
+
+            self._n_int8 = rewrite_program_int8(
+                self._program, self._scope,
+                fetch_names=list(self._fetch_names))
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
 

@@ -9,6 +9,7 @@ the same semantics).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .registry import GRAD_SUFFIX, register_op
@@ -70,3 +71,32 @@ def fake_qdq_moving_avg_kernel(ins, attrs):
         rate * in_scale.reshape(()) + (1.0 - rate) * cur)
     return {"Out": _fake_qdq(x, new_scale, bits),
             "OutScale": new_scale.reshape(1)}
+
+
+@register_op("quantized_matmul", nondiff_slots=("Y", "WScale", "XScale"),
+             no_grad=True)
+def quantized_matmul_kernel(ins, attrs):
+    """Int8 inference matmul: int8 x int8 -> int32 accumulate on the MXU
+    (``lax.dot_general`` with ``preferred_element_type=int32`` — the TPU
+    answer to the reference's TensorRT int8 engine,
+    ``inference/tensorrt/trt_int8_calibrator.h``).
+
+    Y is the pre-quantized int8 weight [K, N]; WScale [N] its per-output-
+    channel dequant scale.  Activations quantize per-tensor: with a
+    calibrated ``XScale`` input (PTQ'd graphs) it is used as-is, otherwise
+    the scale is computed dynamically from the batch abs-max."""
+    x = ins["X"]
+    wq = ins["Y"]
+    ws = ins["WScale"]
+    xs = ins.get("XScale")
+    xf = x.astype(jnp.float32)
+    if xs is None:
+        sx = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / 127.0
+    else:
+        sx = jnp.maximum(xs.reshape(()).astype(jnp.float32), 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (sx * ws.astype(jnp.float32))
+    return {"Out": out.astype(x.dtype)}

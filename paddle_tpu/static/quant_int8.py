@@ -1,0 +1,118 @@
+"""Int8 inference program rewrite — ``Config.enable_int8()``.
+
+Role parity: the reference's TensorRT int8 engine path
+(``inference/tensorrt/trt_int8_calibrator.h`` + the slim post-training →
+inference flow): quantize inference-graph weights to int8 and execute the
+matmuls as int8 x int8 -> int32 on the MXU.
+
+The pass walks the loaded inference Program: every ``matmul_v2`` / ``mul``
+whose ``Y`` is a persistable 2-D parameter is rewritten to the
+``quantized_matmul`` op (ops/quant_ops.py) with a per-output-channel int8
+weight + fp32 dequant scale materialized in the scope.  When the graph
+carries calibrated activation scales (PTQ/QAT export: a
+``fake_quantize_dequantize_moving_average_abs_max`` op feeding the matmul),
+the frozen scale is wired in as ``XScale`` and the fake-quant node is
+bypassed; otherwise activations quantize dynamically per batch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["rewrite_program_int8"]
+
+_FAKE_ACT_OPS = (
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "fake_quantize_dequantize_abs_max",
+)
+
+
+def rewrite_program_int8(program, scope, fetch_names=None) -> int:
+    """Rewrite in place; returns the number of matmuls quantized."""
+    block = program.global_block()
+    n = 0
+    # map: activation var -> (producer fake-quant op, its frozen scale var)
+    fake_out = {}
+    # map: weight fake-quant output -> underlying persistable weight name
+    fake_weight = {}
+    for op in block.ops:
+        if op.type in _FAKE_ACT_OPS:
+            outs = op.output("Out")
+            scales = op.output("OutScale")
+            ins = op.input("InScale")
+            if outs:
+                fake_out[outs[0]] = (op, ins[0] if ins else
+                                     (scales[0] if scales else None))
+        elif op.type in ("fake_channel_wise_quantize_dequantize_abs_max",
+                         "fake_quantize_dequantize_abs_max"):
+            outs = op.output("Out")
+            src = op.input("X")
+            if outs and src:
+                svar = block.vars.get(src[0])
+                if svar is not None and getattr(svar, "persistable", False):
+                    fake_weight[outs[0]] = src[0]
+
+    for op in block.ops:
+        if op.type not in ("matmul_v2", "mul", "matmul"):
+            continue
+        if op.attrs.get("trans_x") or op.attrs.get("transpose_X"):
+            continue
+        ys = op.input("Y")
+        xs_in = op.input("X")
+        if not ys or not xs_in:
+            continue
+        # PTQ/QAT export: Y is a fake-quantized view of the weight — the
+        # int8 path quantizes the underlying weight itself (same channel
+        # abs-max scales), so see through the fake node
+        yname = fake_weight.get(ys[0], ys[0])
+        yvar = block.vars.get(yname)
+        if yvar is None or not getattr(yvar, "persistable", False):
+            continue
+        w = scope.find_var(yname)
+        if w is None:
+            continue
+        w = np.asarray(w)
+        if w.ndim != 2:
+            continue
+        if op.attrs.get("trans_y") or op.attrs.get("transpose_Y"):
+            w = w.T
+        # per-output-channel symmetric scale
+        ws = np.maximum(np.abs(w).max(axis=0), 1e-8) / 127.0
+        wq = np.clip(np.round(w / ws), -127, 127).astype(np.int8)
+        qname, sname = f"{yname}@int8", f"{yname}@wscale"
+        scope.set(qname, wq)
+        scope.set(sname, ws.astype(np.float32))
+        block.create_var(name=qname, shape=wq.shape, dtype="int8",
+                         persistable=True, stop_gradient=True)
+        block.create_var(name=sname, shape=ws.shape, dtype="float32",
+                         persistable=True, stop_gradient=True)
+        new_inputs = {"X": [xs_in[0]], "Y": [qname], "WScale": [sname]}
+        # calibrated activation scale: X produced by a frozen fake-quant
+        src = fake_out.get(xs_in[0])
+        if src is not None and src[1] is not None:
+            new_inputs["X"] = [src[0].input("X")[0]]  # bypass the fake node
+            new_inputs["XScale"] = [src[1]]
+        op.type = "quantized_matmul"
+        op.inputs = new_inputs
+        op.attrs = {}
+        n += 1
+
+    if n:
+        _eliminate_dead_ops(block, fetch_names)
+    return n
+
+
+def _eliminate_dead_ops(block, fetch_names=None):
+    """Drop ops whose outputs nothing consumes (the bypassed fake-quant
+    nodes) — backward liveness sweep over the flat block."""
+    live = set(fetch_names or [])
+    for op in block.ops:
+        if op.type == "fetch":
+            live.update(op.input_arg_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type in ("feed", "fetch") or any(
+                o in live for o in op.output_arg_names) or not op.outputs:
+            keep.append(op)
+            live.update(op.input_arg_names)
+    block.ops[:] = list(reversed(keep))
