@@ -1,9 +1,14 @@
 """``paddle.Model`` — the Keras-like high-level trainer.
 
 Parity: ``/root/reference/python/paddle/hapi/model.py`` (``Model``:878,
-``prepare``:1450, ``fit``/``evaluate``/``predict``:304-area, save/load).
-Runs the dygraph engine (the 2.x default path); static acceleration comes
-from the whole-step jit in the underlying tracer.
+``prepare``:1450, ``fit``/``evaluate``/``predict``, save/load) with BOTH
+engines: the dygraph path (reference ``DynamicGraphAdapter``:792) and a
+static-graph adapter (reference ``StaticGraphAdapter``:304) selected per
+batch by the current mode — under ``paddle.enable_static()`` the Model
+builds train/eval/predict Programs from the declared ``inputs``/``labels``
+InputSpecs (eval/predict are ``clone(for_test=True)`` snapshots taken
+before the optimizer ops) and drives them through the whole-block XLA
+Executor.
 """
 
 from __future__ import annotations
@@ -21,6 +26,201 @@ from .progressbar import ProgressBar
 
 
 from ..static.input import InputSpec  # noqa: F401  (single definition)
+
+
+class _EagerScope:
+    """Temporarily restore dygraph mode (metric math on fetched arrays)."""
+
+    def __enter__(self):
+        from ..framework import program as fw
+
+        self._was_static = not fw.in_dygraph_mode()
+        if self._was_static:
+            fw.disable_static()
+        return self
+
+    def __exit__(self, *exc):
+        from ..framework import program as fw
+
+        if self._was_static:
+            fw.enable_static()
+        return False
+
+
+class _StaticAdapter:
+    """Reference ``StaticGraphAdapter``:304 — Program-per-phase execution.
+
+    Build order matters: forward -> predict clone -> loss -> eval clone ->
+    optimizer ops (train program keeps everything).  A network constructed
+    eagerly (the 2.x norm) has its parameters BOUND into the programs by
+    name with values pushed to the adapter scope — the jit.StaticFunction
+    binding strategy — so the same Layer objects drive both engines."""
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self._built = False
+
+    def _specs(self, specs, kind):
+        if specs is None:
+            raise RuntimeError(
+                f"static-graph Model needs {kind}=[InputSpec(...)] at "
+                f"construction (reference hapi requires declared shapes "
+                f"in static mode)")
+        specs = specs if isinstance(specs, (list, tuple)) else [specs]
+        out = []
+        for i, s in enumerate(specs):
+            if isinstance(s, InputSpec):
+                out.append(s)
+            else:  # bare shape list
+                out.append(InputSpec(list(s), "float32", f"{kind}_{i}"))
+        return out
+
+    def _build(self):
+        if self._built:
+            return
+        import paddle_tpu as paddle
+        from .. import static
+        from ..framework import program as fw
+        from ..framework.scope import Scope
+        from ..nn.layer_base import Layer
+        from ..static.executor import Executor
+
+        m = self.model
+        self._scope = Scope()
+        self._exe = Executor()
+        in_specs = self._specs(m._inputs, "inputs")
+        main, startup = fw.Program(), fw.Program()
+        with fw.program_guard(main, startup):
+            in_vars = [
+                static.data(s.name or f"x_{i}",
+                            [d if d is not None else -1 for d in s.shape],
+                            s.dtype)
+                for i, s in enumerate(in_specs)
+            ]
+            # bind eagerly-created parameters/buffers into this program
+            net = m.network
+            if isinstance(net, Layer):
+                net.train()  # train-form trace; clones flip is_test
+            if isinstance(net, Layer):
+                blk = main.global_block()
+                for _, p in net.named_parameters():
+                    if hasattr(p, "_array"):
+                        blk.create_parameter(shape=p.shape, dtype=p.dtype,
+                                             name=p.name)
+                        self._scope.set(p.name, p._array)
+                for _, b in net.named_buffers():
+                    if hasattr(b, "_array"):
+                        blk.create_var(name=b.name, shape=tuple(b.shape),
+                                       dtype=b.dtype, persistable=True)
+                        self._scope.set(b.name, b._array)
+            outs = net(*in_vars)
+            outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            self._predict_prog = main.clone(for_test=True)
+            self._out_names = [o.name for o in outs]
+
+            label_vars = []
+            if m._loss is not None or m._metrics:
+                l_specs = self._specs(m._labels, "labels")
+                label_vars = [
+                    static.data(
+                        s.name or f"label_{i}",
+                        [d if d is not None else -1 for d in s.shape],
+                        s.dtype)
+                    for i, s in enumerate(l_specs)
+                ]
+            loss_name = None
+            if m._loss is not None:
+                loss = m._loss(*outs, *label_vars)
+                loss_name = loss.name
+                self._eval_prog = main.clone(for_test=True)
+                if m._optimizer is not None:
+                    m._optimizer.minimize(loss)
+            else:
+                self._eval_prog = self._predict_prog
+            self._train_prog = main
+            self._loss_name = loss_name
+        self._in_names = [v.name for v in in_vars]
+        self._label_names = [v.name for v in label_vars]
+        self._exe.run(startup, scope=self._scope)
+        # startup re-initialized any STATIC-built params; eager-built
+        # values win (they are the user's trained/loaded state)
+        if isinstance(m.network, Layer):
+            for _, p in m.network.named_parameters():
+                if hasattr(p, "_array"):
+                    self._scope.set(p.name, p._array)
+        self._built = True
+
+    def _feeds(self, ins, labels=None):
+        feed = {}
+        for name, a in zip(self._in_names, ins):
+            feed[name] = a.numpy() if hasattr(a, "numpy") else np.asarray(a)
+        if labels is not None:
+            labels = (labels if isinstance(labels, (list, tuple))
+                      else [labels])
+            for name, a in zip(self._label_names, labels):
+                feed[name] = (a.numpy() if hasattr(a, "numpy")
+                              else np.asarray(a))
+        return feed
+
+    def train_batch(self, ins, labels=None):
+        self._build()
+        m = self.model
+        fetches = [self._loss_name] + self._out_names
+        res = self._exe.run(self._train_prog, feed=self._feeds(ins, labels),
+                            fetch_list=fetches, scope=self._scope)
+        loss, outs = res[0], res[1:]
+        self._update_metrics(outs, labels)
+        return Tensor(np.asarray(loss), stop_gradient=True)
+
+    def eval_batch(self, ins, labels=None):
+        self._build()
+        fetches = ([self._loss_name] if self._loss_name else []) \
+            + self._out_names
+        res = self._exe.run(self._eval_prog, feed=self._feeds(ins, labels),
+                            fetch_list=fetches, scope=self._scope)
+        if self._loss_name:
+            loss, outs = res[0], res[1:]
+        else:
+            loss, outs = np.zeros(()), res
+        self._update_metrics(outs, labels)
+        return Tensor(np.asarray(loss), stop_gradient=True)
+
+    def predict_batch(self, ins):
+        self._build()
+        res = self._exe.run(self._predict_prog, feed=self._feeds(ins),
+                            fetch_list=self._out_names, scope=self._scope)
+        outs = [Tensor(np.asarray(r), stop_gradient=True) for r in res]
+        return outs[0] if len(outs) == 1 else outs
+
+    def _update_metrics(self, outs, labels):
+        m = self.model
+        if not m._metrics:
+            return
+        with _EagerScope():
+            out_t = [Tensor(np.asarray(o), stop_gradient=True)
+                     for o in outs]
+            labels = (labels if isinstance(labels, (list, tuple))
+                      else [labels])
+            lab_t = [Tensor(np.asarray(
+                l.numpy() if hasattr(l, "numpy") else l),
+                stop_gradient=True) for l in labels]
+            for metric in m._metrics:
+                Model._update_metric(
+                    metric, out_t[0] if len(out_t) == 1 else out_t, lab_t)
+
+    def sync_to_network(self):
+        """Write the trained scope values back into the Layer objects so
+        dygraph state_dict/save see the static-trained weights."""
+        import jax.numpy as jnp
+
+        from ..nn.layer_base import Layer
+
+        if not self._built or not isinstance(self.model.network, Layer):
+            return
+        for _, p in self.model.network.named_parameters():
+            arr = self._scope.find_var(p.name)
+            if arr is not None and hasattr(p, "_array"):
+                p._array = jnp.asarray(np.asarray(arr))
 
 
 class Model:
@@ -61,9 +261,22 @@ class Model:
             res = (res,)
         m.update(*res)
 
+    @property
+    def _adapter(self) -> Optional[_StaticAdapter]:
+        from ..framework import program as fw
+
+        if fw.in_dygraph_mode():
+            return None
+        if getattr(self, "_static_adapter", None) is None:
+            self._static_adapter = _StaticAdapter(self)
+        return self._static_adapter
+
     def train_batch(self, inputs, labels=None):
-        self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        adapter = self._adapter
+        if adapter is not None:
+            return adapter.train_batch(inputs, labels)
+        self.network.train()
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -74,8 +287,11 @@ class Model:
         return loss
 
     def eval_batch(self, inputs, labels=None):
-        self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        adapter = self._adapter
+        if adapter is not None:
+            return adapter.eval_batch(inputs, labels)
+        self.network.eval()
         from ..dygraph.base import no_grad
 
         with no_grad():
@@ -86,8 +302,11 @@ class Model:
         return loss
 
     def predict_batch(self, inputs):
-        self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        adapter = self._adapter
+        if adapter is not None:
+            return adapter.predict_batch(inputs)
+        self.network.eval()
         from ..dygraph.base import no_grad
 
         with no_grad():
@@ -210,9 +429,15 @@ class Model:
     def save(self, path, training=True):
         from .. import io_api
 
-        io_api.save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
-            io_api.save(self._optimizer.state_dict(), path + ".pdopt")
+        # static-trained weights live in the adapter scope — sync them
+        # into the Layer objects so ONE state_dict serves both engines
+        adapter = getattr(self, "_static_adapter", None)
+        if adapter is not None:
+            adapter.sync_to_network()
+        with _EagerScope():
+            io_api.save(self.network.state_dict(), path + ".pdparams")
+            if training and self._optimizer is not None:
+                io_api.save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from .. import io_api
