@@ -64,19 +64,34 @@ def _ring_block(q, k, v, o, m, l, q_off, kv_off, scale, causal):
 
 
 def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None):
     """Attention over sequence-sharded Q/K/V (global arrays, (B, H, S, D)).
 
     The sequence dim is (re)sharded over ``axis``; returns the global
     output with the same sharding.  Equivalent to
     ``softmax(QK^T * scale [+causal mask]) V`` computed without any device
     ever holding the full sequence.
+
+    ``use_flash`` selects the per-device block engine: the Pallas flash
+    kernel (default on TPU; per-visiting-block flash with global-LSE
+    merging — see :func:`ring_flash_attention`) or the einsum online-
+    softmax fallback.  The single-device fallback dispatches through
+    ``sdpa`` and therefore also runs flash on TPU.
     """
     mesh = mesh_mod.get_mesh()
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
-        from .attention import _sdpa_reference
+        # single chip: the sdpa dispatcher picks the flash kernel on TPU
+        from .attention import sdpa
 
-        return _sdpa_reference(q, k, v, scale=scale, is_causal=causal)
+        return sdpa(q, k, v, scale=scale, is_causal=causal)
+    if use_flash is None:
+        from . import flash as _fl
+
+        use_flash = _fl.available() and _fl.supported(q, k)
+    if use_flash:
+        return ring_flash_attention(q, k, v, axis=axis, causal=causal,
+                                    scale=scale)
     ring = int(mesh.shape[axis])
     b, h, s, d = q.shape
     if s % ring:
@@ -125,5 +140,150 @@ def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
                        out_specs=spec, check_vma=False)
     except TypeError:  # pragma: no cover - older shard_map signature
         fn = shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# ring + flash composition
+# ---------------------------------------------------------------------------
+
+
+def ring_flash_attention(q, k, v, axis: str = "mp", causal: bool = False,
+                         scale: Optional[float] = None,
+                         interpret: Optional[bool] = None):
+    """Ring attention whose per-device block engine is the Pallas flash
+    kernel (kernels/flash.py) instead of the einsum online-softmax.
+
+    Forward: each ring step runs flash over (local Q, visiting K/V block)
+    — the diagonal step with the kernel's causal mask, later steps gated
+    by block visibility — and the per-block (out, lse) pairs merge by
+    log-sum-exp weighting into the exact global softmax.
+
+    Backward (custom vjp): the flash backward kernels take the GLOBAL lse
+    and global-out delta, so replaying them per visiting block yields the
+    exact partial dq / dk / dv sums; dk/dv accumulators travel the ring
+    WITH their K/V blocks and arrive home after the full cycle.
+    """
+    import functools
+
+    from . import flash as _fl
+
+    mesh = mesh_mod.get_mesh()
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        from .attention import sdpa
+
+        return sdpa(q, k, v, scale=scale, is_causal=causal)
+    ring = int(mesh.shape[axis])
+    b, h, s, d = q.shape
+    if s % ring:
+        raise ValueError(f"seq len {s} must divide the ring size {ring}")
+    s_local = s // ring
+    blk = _fl._pick_block(s_local)
+    if blk is None or d % 8 != 0 or not (16 <= d <= 256):
+        # shapes the Mosaic kernel can't take: einsum engine
+        return ring_attention(q, k, v, axis=axis, causal=causal,
+                              scale=scale, use_flash=False)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        from .flash import _backend_is_tpu
+
+        interpret = not _backend_is_tpu()
+
+    spec = P(None, None, axis, None)
+    sharded = NamedSharding(mesh, spec)
+    q = jax.device_put(jnp.asarray(q), sharded)
+    k = jax.device_put(jnp.asarray(k), sharded)
+    v = jax.device_put(jnp.asarray(v), sharded)
+    perm = [(src, (src + 1) % ring) for src in range(ring)]
+
+    def _merge(o_acc, L, o_r, lse_r):
+        """LSE-weighted merge of a normalized block output into the
+        accumulator.  o: (bh, s, d) f32; lse/L: (bh, 1, s) f32."""
+        m = jnp.maximum(L, lse_r)
+        m_safe = jnp.where(jnp.isinf(m) & (m < 0), 0.0, m)
+        w_old = jnp.where(L <= NEG_INF / 2, 0.0, jnp.exp(L - m_safe))
+        w_new = jnp.where(lse_r <= NEG_INF / 2, 0.0,
+                          jnp.exp(lse_r - m_safe))
+        denom = jnp.maximum(w_old + w_new, 1e-30)
+        wo = (w_old / denom)[:, 0, :, None]
+        wn = (w_new / denom)[:, 0, :, None]
+        o_new = o_acc * wo + o_r.astype(jnp.float32) * wn
+        return o_new, m_safe + jnp.log(denom)
+
+    def _gate(lse_r, i, r):
+        if not causal or r == 0:
+            return lse_r
+        visible = ((i - r) % ring) < i
+        return jnp.where(visible, lse_r, jnp.float32(NEG_INF))
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def _pd(ql, kl, vl):
+        out, _ = _pd_fwd(ql, kl, vl)
+        return out
+
+    def _pd_fwd(ql, kl, vl):
+        i = lax.axis_index(axis)
+        bh = ql.shape[0] * ql.shape[1]
+        q3 = ql.reshape(bh, s_local, d)
+        k_r = kl.reshape(bh, s_local, d)
+        v_r = vl.reshape(bh, s_local, d)
+        o_acc = jnp.zeros((bh, s_local, d), jnp.float32)
+        L = jnp.full((bh, 1, s_local), jnp.float32(NEG_INF))
+        for r in range(ring):
+            o_r, lse_r = _fl._flash_fwd(
+                q3, k_r, v_r, scale, causal and r == 0, blk, blk, interpret)
+            lse_r = _gate(lse_r, i, r)
+            o_acc, L = _merge(o_acc, L, o_r, lse_r)
+            k_r = lax.ppermute(k_r, axis, perm)
+            v_r = lax.ppermute(v_r, axis, perm)
+        out = o_acc.astype(ql.dtype).reshape(ql.shape)
+        return out, (ql, kl, vl, o_acc, L, i)
+
+    def _pd_bwd(res, do):
+        ql, kl, vl, o_acc, L, i = res
+        bh = ql.shape[0] * ql.shape[1]
+        q3 = ql.reshape(bh, s_local, d)
+        k_r = kl.reshape(bh, s_local, d)
+        v_r = vl.reshape(bh, s_local, d)
+        do3 = do.reshape(bh, s_local, d)
+        out3 = o_acc.astype(q3.dtype)
+        dq = jnp.zeros((bh, s_local, d), jnp.float32)
+        dk_acc = jnp.zeros((bh, s_local, d), jnp.float32)
+        dv_acc = jnp.zeros((bh, s_local, d), jnp.float32)
+        for r in range(ring):
+            dq_r, dk_r, dv_r = _fl._flash_bwd(
+                q3, k_r, v_r, out3, L, do3, scale, causal and r == 0,
+                blk, blk, interpret)
+            if causal and r > 0:
+                g = (((i - r) % ring) < i).astype(jnp.float32)
+                dq_r = dq_r * g
+                dk_r = dk_r * g
+                dv_r = dv_r * g
+            dq = dq + dq_r.astype(jnp.float32)
+            dk_acc = dk_acc + dk_r.astype(jnp.float32)
+            dv_acc = dv_acc + dv_r.astype(jnp.float32)
+            k_r = lax.ppermute(k_r, axis, perm)
+            v_r = lax.ppermute(v_r, axis, perm)
+            dk_acc = lax.ppermute(dk_acc, axis, perm)
+            dv_acc = lax.ppermute(dv_acc, axis, perm)
+        shp = ql.shape
+        return (dq.astype(ql.dtype).reshape(shp),
+                dk_acc.astype(kl.dtype).reshape(shp),
+                dv_acc.astype(vl.dtype).reshape(shp))
+
+    _pd.defvjp(_pd_fwd, _pd_bwd)
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    try:
+        fn = shard_map(_pd, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:  # pragma: no cover - older shard_map signature
+        fn = shard_map(_pd, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_rep=False)
     return fn(q, k, v)

@@ -98,3 +98,54 @@ def test_ring_falls_back_without_mesh_axis():
     out = np.asarray(ring_attention(q, q, q, axis="mp", causal=False))
     ref = np.asarray(_sdpa_reference(q, q, q, is_causal=False))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_flash_composition_matches_reference(causal):
+    """Round-4 verdict item 9: the ring path composes with the Pallas
+    flash kernel as the per-device block engine (interpret mode on the
+    CPU mesh) — forward parity vs the dense reference."""
+    from paddle_tpu.kernels.ring import ring_flash_attention
+
+    _init(mp=8)
+    rng = np.random.RandomState(4)
+    b, h, s, d = 1, 2, 512, 16
+    q = rng.randn(b, h, s, d).astype("float32")
+    k = rng.randn(b, h, s, d).astype("float32")
+    v = rng.randn(b, h, s, d).astype("float32")
+    out = np.asarray(ring_flash_attention(q, k, v, axis="mp",
+                                          causal=causal, interpret=True))
+    ref = np.asarray(_sdpa_reference(q, k, v, is_causal=causal))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_gradients_match_reference():
+    """Exact grads through the ring+flash composition: the flash backward
+    kernels replayed per visiting block with the global LSE."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.ring import ring_flash_attention
+
+    _init(mp=8)
+    rng = np.random.RandomState(5)
+    b, h, s, d = 1, 1, 256, 16
+    q = rng.randn(b, h, s, d).astype("float32")
+    k = rng.randn(b, h, s, d).astype("float32")
+    v = rng.randn(b, h, s, d).astype("float32")
+    w = rng.randn(b, h, s, d).astype("float32")  # cotangent projector
+
+    def ring_loss(q, k, v):
+        out = ring_flash_attention(q, k, v, axis="mp", causal=True,
+                                   interpret=True)
+        return jnp.sum(out * w)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(_sdpa_reference(q, k, v, is_causal=True) * w)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=f"d{name} mismatch")
