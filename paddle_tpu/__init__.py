@@ -76,6 +76,8 @@ from . import distribution  # noqa: F401
 from . import compat  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import reader  # noqa: F401
+from . import device  # noqa: F401
+from . import utils  # noqa: F401
 
 # ``paddle.tensor`` module alias (reference exposes the tensor function
 # namespace as a real submodule): make ``import paddle_tpu.tensor`` work

@@ -1,0 +1,72 @@
+"""Device management namespace — ``paddle.device``.
+
+Role parity: ``/root/reference/python/paddle/device.py`` (set_device:
+resolve + pin the active place; get_device; is_compiled_with_* probes;
+get_cudnn_version), re-exported at the reference top level
+(``python/paddle/__init__.py:266-272``).  Device identity here comes from
+the live JAX backend (TPU/CPU), not compile-time flags.
+"""
+
+from .framework import (  # noqa: F401
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .framework.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    NPUPlace,
+    TPUPlace,
+    XPUPlace,
+)
+
+__all__ = ["get_device", "set_device", "get_cudnn_version",
+           "is_compiled_with_cuda", "is_compiled_with_tpu",
+           "is_compiled_with_xpu", "is_compiled_with_npu",
+           "is_compiled_with_rocm", "XPUPlace", "get_all_device_type",
+           "get_all_custom_device_type", "get_available_device",
+           "get_available_custom_device"]
+
+
+def get_cudnn_version():
+    """None — no cuDNN in the XLA/TPU stack (reference returns the
+    compiled version number on CUDA builds)."""
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def get_all_device_type():
+    import jax
+
+    kinds = {d.platform for d in jax.devices()}
+    return sorted(kinds | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+
+    out = []
+    for d in jax.devices():
+        out.append(f"{d.platform}:{d.id}")
+    return out
+
+
+def get_available_custom_device():
+    return []

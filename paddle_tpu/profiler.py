@@ -200,3 +200,97 @@ def start(logdir: Optional[str] = None) -> None:
 def stop(sorted_key: Optional[str] = None,
          profile_path: str = "/tmp/profile") -> None:
     stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------------------
+# utils.profiler surface (reference python/paddle/utils/profiler.py)
+# ---------------------------------------------------------------------------
+
+
+class ProfilerOptions:
+    """Option bag for :class:`Profiler` (reference ProfilerOptions)."""
+
+    DEFAULTS = {
+        "state": "All",
+        "sorted_key": "total",
+        "tracer_level": "Default",
+        "batch_range": [0, 100],
+        "output_thread_detail": False,
+        "profile_path": "none",
+        "timeline_path": "none",
+        "op_summary_path": "none",
+    }
+
+    def __init__(self, options=None):
+        self._options = dict(self.DEFAULTS)
+        if options is not None:
+            self._options.update(options)
+
+    def with_state(self, state):
+        self._options["state"] = state
+        return self
+
+    def __getitem__(self, name):
+        if name not in self._options:
+            raise ValueError(f"ProfilerOptions does not have an option "
+                             f"named {name}")
+        return self._options[name]
+
+
+_profiler_singleton = None
+
+
+class Profiler:
+    """Batch-windowed profiler driver (reference utils/profiler.Profiler):
+    profiles batches inside ``batch_range`` between reset_/start_/stop."""
+
+    def __init__(self, enabled: bool = True, options=None):
+        self._enabled = enabled
+        self._options = (options if isinstance(options, ProfilerOptions)
+                         else ProfilerOptions(options))
+        self._batch = 0
+        self._running = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        if self._enabled and not self._running:
+            lo = self._options["batch_range"][0]
+            if self._batch >= lo:
+                start_profiler(state=self._options["state"],
+                               tracer_option=self._options["tracer_level"])
+                self._running = True
+
+    def stop(self):
+        if self._running:
+            path = self._options["profile_path"]
+            kw = {} if path == "none" else {"profile_path": path}
+            stop_profiler(sorted_key=self._options["sorted_key"], **kw)
+            self._running = False
+
+    def reset(self):
+        reset_profiler()
+        self._batch = 0
+
+    def record_step(self, change_profiler_status: bool = True):
+        self._batch += 1
+        if not (self._enabled and change_profiler_status):
+            return
+        lo, hi = self._options["batch_range"]
+        if self._batch == lo and not self._running:
+            self.start()
+        elif self._batch == hi and self._running:
+            self.stop()
+
+
+def get_profiler(options=None) -> Profiler:
+    global _profiler_singleton
+    if _profiler_singleton is None:
+        _profiler_singleton = Profiler(options=options)
+    return _profiler_singleton

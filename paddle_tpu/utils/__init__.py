@@ -1,7 +1,99 @@
 """``paddle.utils`` — extension loading and misc utilities.
 
-Parity: ``/root/reference/python/paddle/utils/`` (cpp_extension, op
-library loading)."""
+Parity: ``/root/reference/python/paddle/utils/__init__.py`` —
+``deprecated`` (deprecated.py:119), ``try_import`` (lazy_import.py),
+``run_check`` (install_check.py), ``require_version``
+(fluid/framework.py), ``unique_name``, ``download``, ``cpp_extension``,
+and the profiler re-exports.
+"""
 
 from . import cpp_extension  # noqa: F401
+from . import download  # noqa: F401
 from .cpp_extension import load_op_library  # noqa: F401
+from ..framework import unique_name  # noqa: F401
+from ..profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to="", since="", reason=""):
+    """Mark an API deprecated: amend the docstring and warn once per call
+    site (reference utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if update_to:
+            msg += f", please use \"{update_to}\" instead"
+        if since:
+            msg += f" since {since}"
+        if reason:
+            msg += f", reason: {reason}"
+        func.__doc__ = (f"\n    Warning:\n        {msg}\n\n"
+                        + (func.__doc__ or ""))
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency with an actionable error
+    (reference utils/lazy_import.py)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (f"Failed importing {module_name}. This likely means "
+                       f"that some paddle modules require additional "
+                       f"dependencies that have to be manually installed "
+                       f"(usually with `pip install {module_name}`).")
+        raise ImportError(err_msg)
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range
+    (reference fluid/framework.py require_version)."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(paddle_tpu.__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"VersionError: paddle_tpu version {paddle_tpu.__version__} is "
+            f"below the required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"VersionError: paddle_tpu version {paddle_tpu.__version__} is "
+            f"above the allowed maximum {max_version}")
+
+
+def run_check():
+    """Sanity-check the install: run a small matmul + grad on the live
+    backend and report (reference utils/install_check.py run_check)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    dev = paddle.get_device()
+    x = paddle.to_tensor(np.ones((4, 4), "float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.full((4, 4), 0.5, "float32"),
+                         stop_gradient=False)
+    y = paddle.matmul(x, w).sum()
+    y.backward()
+    got = float(np.asarray(y.numpy()))
+    assert abs(got - 32.0) < 1e-4, f"matmul check failed: {got}"
+    g = np.asarray(x.grad.numpy())
+    assert np.allclose(g, 2.0), "backward check failed"
+    print(f"PaddlePaddle (paddle_tpu) is installed successfully! "
+          f"Device: {dev}.")

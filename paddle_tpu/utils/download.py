@@ -1,0 +1,49 @@
+"""Weights-cache resolution — ``paddle.utils.download``.
+
+Role parity: ``/root/reference/python/paddle/utils/download.py``
+(``get_weights_path_from_url``:386-file module — URL fetch + md5-checked
+cache under ``~/.cache/paddle``).  This build runs in a zero-egress
+environment: the same cache layout is honored (a pre-seeded file is
+found, md5-verified, and reused), and a missing file raises with the
+exact path to place it at instead of attempting a network fetch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _md5check(fullname: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
+                      check_exist: bool = True) -> str:
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if osp.exists(fullname) and (not check_exist
+                                 or _md5check(fullname, md5sum)):
+        return fullname
+    raise RuntimeError(
+        f"weights file {fname!r} not found in the local cache and this "
+        f"environment has no network egress.  Place the file (from {url}) "
+        f"at: {fullname}")
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """Resolve a weights URL to a local cached path (zero-egress: cache
+    lookup only; reference downloads on miss)."""
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -6,6 +6,8 @@ Parity targets: ``/root/reference/python/paddle/reader/decorator.py``,
 
 import os
 
+import numpy as np
+
 import pytest
 
 import paddle_tpu as paddle
@@ -97,3 +99,70 @@ def test_tensor_module_alias():
     import paddle_tpu.tensor as pt
 
     assert pt.concat is paddle.concat
+
+
+def test_device_namespace():
+    import paddle_tpu.device as dev
+
+    assert dev.get_cudnn_version() is None
+    assert not dev.is_compiled_with_rocm()
+    assert isinstance(dev.get_all_device_type(), list)
+    assert isinstance(dev.get_available_device(), list)
+    assert paddle.device.get_device  # attribute chain
+
+
+def test_utils_surface(capsys):
+    from paddle_tpu import utils
+
+    # deprecated: warns and annotates
+    import warnings
+
+    @utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api() == 42
+    assert any("deprecated" in str(x.message) for x in w)
+    assert "Warning" in old_api.__doc__
+
+    assert utils.try_import("math").sqrt(4) == 2.0
+    with pytest.raises(ImportError, match="no_such_module_xyz"):
+        utils.try_import("no_such_module_xyz")
+
+    utils.require_version("0.0.1")
+    with pytest.raises(Exception, match="VersionError"):
+        utils.require_version("99.0.0")
+
+    utils.run_check()
+    assert "successfully" in capsys.readouterr().out
+
+    # zero-egress download: clear guidance instead of a fetch
+    with pytest.raises(RuntimeError, match="no network egress"):
+        utils.download.get_weights_path_from_url(
+            "https://example.com/weights_xyz.pdparams")
+    # pre-seeded cache file resolves
+    import os
+
+    seeded = os.path.join(utils.download.WEIGHTS_HOME, "seeded.pdparams")
+    os.makedirs(utils.download.WEIGHTS_HOME, exist_ok=True)
+    with open(seeded, "wb") as f:
+        f.write(b"x")
+    got = utils.download.get_weights_path_from_url(
+        "https://example.com/seeded.pdparams")
+    assert got == seeded
+
+
+def test_utils_profiler_wrapper():
+    from paddle_tpu.utils import Profiler, ProfilerOptions, get_profiler
+
+    opts = ProfilerOptions({"batch_range": [0, 3], "state": "CPU"})
+    assert opts["state"] == "CPU"
+    with pytest.raises(ValueError):
+        opts["nope"]
+    p = Profiler(enabled=True, options=opts)
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with p:
+        (x + x).numpy()
+    assert get_profiler() is get_profiler()

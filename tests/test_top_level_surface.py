@@ -412,7 +412,7 @@ REFERENCE_SUBMODULE_IMPORTS = [
     "compat", "distributed", "sysconfig", "distribution", "nn",
     "distributed.fleet", "optimizer", "metric", "regularizer", "incubate",
     "autograd", "jit", "amp", "dataset", "inference", "io", "onnx",
-    "reader", "static", "vision", "text", "tensor",
+    "reader", "static", "vision", "text", "tensor", "device", "utils",
 ]
 
 
