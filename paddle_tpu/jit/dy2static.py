@@ -493,6 +493,11 @@ def _convert_uncached(fn: Callable) -> Callable:
         return fn
     if not _contains(fdef.body, (ast.If, ast.While, ast.For)):
         return fn  # nothing to do
+    if "__class__" in fn.__code__.co_freevars:
+        # zero-arg super() needs the __class__ closure cell, which cannot
+        # be rebuilt through exec; such methods keep Python semantics
+        # (use super(Cls, self) if tensor control flow is also needed)
+        return fn
 
     fdef.decorator_list = []  # drop @to_static etc. — we are past them
     fdef.body = _normalize_tail(fdef.body)

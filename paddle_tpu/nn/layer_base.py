@@ -330,7 +330,23 @@ class Layer:
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        fwd = self.forward
+        if not fw.in_dygraph_mode() and not getattr(
+                fwd, "__dy2static_converted__", False):
+            # transitive dy2static (reference converts callee layers too,
+            # program_translator.convert_call role): under a static trace,
+            # a SUB-layer's data-dependent Python control flow must also
+            # lower to cond/while ops — convert its forward on the fly
+            # (cached per code object; plain forwards return unchanged)
+            from ..jit import dy2static as _d2s
+
+            conv = _d2s.convert_func(getattr(fwd, "__func__", fwd))
+            if conv is not getattr(fwd, "__func__", fwd):
+                outputs = conv(self, *inputs, **kwargs)
+            else:
+                outputs = fwd(*inputs, **kwargs)
+        else:
+            outputs = fwd(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             out = hook(self, inputs, outputs)
             if out is not None:

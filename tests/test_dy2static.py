@@ -239,3 +239,35 @@ def test_counted_loop_is_differentiable_via_fori():
         np.testing.assert_allclose(np.asarray(out[1]), 6.0 * xv)
     finally:
         paddle.disable_static()
+
+
+def test_sublayer_forward_converts_transitively():
+    """A SUB-layer's tensor control flow converts too (the reference's
+    convert_call transitivity), not only the top decorated function."""
+    from paddle_tpu import nn
+
+    class Inner(nn.Layer):
+        def forward(self, x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x * -3.0
+
+    class Outer(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = Inner()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.inner(self.fc(x))
+
+    paddle.seed(1)
+    m = Outer()
+    m.eval()
+    pos = np.full((2, 4), 2.0, "float32")
+    neg = np.full((2, 4), -2.0, "float32")
+    ms = jit.to_static(m)
+    for x in (pos, neg):
+        eager = np.asarray(m.inner(m.fc(paddle.to_tensor(x))).numpy())
+        static = np.asarray(ms(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(static, eager, rtol=1e-5, atol=1e-6)
