@@ -179,3 +179,94 @@ def server_endpoints(to_string=False):
 
 def is_server():
     return False
+
+
+# -- fleet save APIs (fleet_base.py:697/732) --------------------------------
+
+
+def save_inference_model(executor, dirname, feeded_var_names, target_vars,
+                         main_program=None, export_for_deployment=True,
+                         mode=0):
+    """Rank-0 inference export (fleet_base.py:697) — under the
+    single-controller SPMD model only process 0 writes."""
+    from ... import static as static_mod
+
+    if dist_env.get_rank() != 0:
+        return
+    prog = main_program or static_mod.default_main_program()
+    blk = prog.global_block()
+    feed_vars = [blk.var(n) if isinstance(n, str) else n
+                 for n in feeded_var_names]
+    import os as _os
+
+    prefix = _os.path.join(dirname, "model")
+    static_mod.save_inference_model(prefix, feed_vars, list(target_vars),
+                                    executor, program=prog)
+
+
+def save_persistables(executor, dirname, main_program=None, mode=0):
+    """Rank-0 program-state snapshot (fleet_base.py:732)."""
+    from ...static import io as static_io
+    from ... import static as static_mod
+
+    if dist_env.get_rank() != 0:
+        return
+    import os as _os
+
+    prog = main_program or static_mod.default_main_program()
+    _os.makedirs(dirname, exist_ok=True)
+    static_io.save(prog, _os.path.join(dirname, "persistables"))
+
+
+class UtilBase:
+    """Parity: fleet/base/util_factory.py UtilBase — cross-worker helper
+    math over the collective surface + host-side file sharding."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .. import collective as C
+        from ...dygraph.tensor import Tensor
+
+        t = Tensor(np.asarray(input))
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        C.all_reduce(t, op=op)
+        return np.asarray(t.numpy())
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        from .. import collective as C
+        from ...dygraph.tensor import Tensor
+
+        out = []
+        C.all_gather(out, Tensor(np.asarray(input)))
+        return [np.asarray(t.numpy()) for t in out]
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+
+        C.barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (util_factory
+        get_file_shard semantics: first ``len % n`` workers get one
+        extra)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        n = max(dist_env.get_world_size(), 1)
+        idx = dist_env.get_rank()
+        base, extra = divmod(len(files), n)
+        counts = [base + (1 if i < extra else 0) for i in range(n)]
+        start = sum(counts[:idx])
+        return files[start:start + counts[idx]]
+
+    def print_on_rank(self, message, rank_id):
+        if dist_env.get_rank() == rank_id:
+            print(message, flush=True)
+
+
+util = UtilBase()
+
+from . import utils  # noqa: E402,F401

@@ -258,3 +258,39 @@ def test_elastic_heartbeat_watchdog(tmp_path):
     m1.exit()
     m0.exit()
     assert m0.watch() == ElasticStatus.COMPLETED
+
+
+def test_fleet_save_apis_and_utilbase(tmp_path):
+    """fleet.save_inference_model/save_persistables (fleet_base.py:697/732)
+    + UtilBase helpers."""
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.distributed import fleet
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 6], "float32")
+            pred = static.nn.fc(x, 2)
+            loss = pred.sum()
+            opt.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        fleet.save_inference_model(exe, str(tmp_path / "inf"), ["x"],
+                                   [pred], main_program=main)
+        fleet.save_persistables(exe, str(tmp_path / "per"),
+                                main_program=main)
+        import os
+
+        assert os.path.exists(str(tmp_path / "inf"))
+        assert os.listdir(str(tmp_path / "per"))
+    finally:
+        paddle.disable_static()
+
+    u = fleet.UtilBase()
+    assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]  # 1 worker
+    assert fleet.util.get_file_shard([]) == []
+    with pytest.raises(TypeError):
+        u.get_file_shard("not-a-list")
