@@ -9,11 +9,15 @@ TPU-first notes:
     ``paddle_tpu.distributed.launch``).  In single-program SPMD, grads are
     already globally reduced, so the averaging is a no-op by construction.
   * DGC: the ALGORITHM (top-k gradient sparsification with local gradient
-    accumulation + momentum correction, Lin et al. 2018) is preserved; the
-    transport stays XLA's dense collectives — on ICI the bandwidth saving
-    of sparse allreduce does not pay for the gather/scatter, so DGC here
-    is the optimizer-quality component only (honest divergence from the
-    reference's sparse NCCL transport).
+    accumulation + momentum correction, Lin et al. 2018) is preserved AND
+    the cross-process transport is genuinely sparse: each rank ships only
+    its top-k (value, index) pairs — static [world, k] shapes — via
+    ``process_allgather``, and the received updates scatter-sum into a
+    dense apply.  Per-step traffic is ``2k x world`` words instead of the
+    dense ``n`` (k = (1-sparsity) x n, e.g. 0.1% at sparsity 0.999).
+    Within one SPMD program (single controller) grads are already reduced
+    by XLA, so the sparse exchange only engages on the multi-process
+    launcher path.
 """
 
 from __future__ import annotations
@@ -119,6 +123,7 @@ class DGCMomentumOptimizer:
             pgs = [(p, p.grad) for p in self._params if p.grad is not None]
             for (p, _), (_, g2) in zip(pgs, clip(pgs)):
                 p.grad._array = g2._array
+        world = jax.process_count()
         for p in self._params:
             if p.grad is None:
                 continue
@@ -126,12 +131,26 @@ class DGCMomentumOptimizer:
             u = self._u.get(id(p), jnp.zeros_like(g))
             # momentum correction: u IS the velocity, accumulated locally
             u = self._momentum * u + g
-            flat = jnp.abs(u).reshape(-1)
-            k = max(int(flat.size * (1.0 - s)), 1)
-            thresh = jnp.sort(flat)[-k]
-            mask = (jnp.abs(u) >= thresh).astype(u.dtype)
-            send = u * mask
+            flat_u = u.reshape(-1)
+            n = flat_u.size
+            k = max(int(n * (1.0 - s)), 1)
+            _, idx = jax.lax.top_k(jnp.abs(flat_u), k)
+            vals = flat_u[idx]
+            mask = jnp.zeros((n,), u.dtype).at[idx].set(1.0).reshape(
+                u.shape)
             self._u[id(p)] = u * (1.0 - mask)  # keep the residual
+            if world > 1:
+                # SPARSE transport: 2k words per rank instead of dense n
+                # (the reference's sparse NCCL allgather role)
+                from jax.experimental import multihost_utils
+
+                g_vals = multihost_utils.process_allgather(vals)
+                g_idx = multihost_utils.process_allgather(idx)
+                send = jnp.zeros((n,), u.dtype).at[
+                    g_idx.reshape(-1)].add(g_vals.reshape(-1))
+                send = (send / world).reshape(u.shape)  # DP mean semantics
+            else:
+                send = (u * mask)
             # plain-SGD apply of the selected velocity — the reference's
             # dgc_momentum op does the same post-rampup; feeding `send`
             # through the inner Momentum would apply momentum TWICE

@@ -1,0 +1,61 @@
+"""2-rank DGC sparse-transport check (run by test_asp_meta_optimizers via
+the launcher).  Each rank holds a DIFFERENT local gradient; after one DGC
+step both ranks' params must be identical and equal a numpy simulation of
+the sparse top-k exchange (mean semantics)."""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in flags.split() if "host_platform_device_count" not in f)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.distributed import parallel  # noqa: E402
+
+env = parallel.init_parallel_env()
+rank, ws = env.rank, env.world_size
+assert ws == 2
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_optimizers import (  # noqa: E402
+    DGCMomentumOptimizer,
+)
+
+n = 16
+paddle.seed(0)
+w = paddle.to_tensor(np.zeros((n,), "float32"), stop_gradient=False)
+# rank-specific sparse-ish gradients with known top-1 positions
+g = np.zeros((n,), "float32")
+g[2 + rank] = 10.0 * (rank + 1)   # rank 0 -> idx 2 (10), rank 1 -> idx 3 (20)
+g[8] = 0.1                        # below the cut on both ranks
+w.grad = paddle.to_tensor(g)
+
+opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0, parameters=[w],
+                           rampup_begin_step=0,
+                           sparsity=[1.0 - 1.0 / n])  # k = 1
+opt.step()
+
+out = np.asarray(w.numpy())
+# expected: rank0 ships (10 @ idx2), rank1 ships (20 @ idx3); mean over 2
+expect = np.zeros((n,), "float32")
+expect[2] = -1.0 * 10.0 / 2
+expect[3] = -1.0 * 20.0 / 2
+np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-7)
+
+# both ranks landed on identical params (the transport is the sync)
+from jax.experimental import multihost_utils  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+gathered = np.asarray(multihost_utils.process_allgather(jnp.asarray(out)))
+np.testing.assert_allclose(gathered[0], gathered[1], rtol=0, atol=0)
+
+# the residual kept the unsent small entry
+resid = np.asarray(list(opt._u.values())[0]).reshape(-1)
+assert abs(resid[8] - 0.1) < 1e-6
+print(f"rank {rank}: DGC sparse transport OK", flush=True)
