@@ -226,3 +226,32 @@ class GradientMergeOptimizer:
 
     def __getattr__(self, name):
         return getattr(self.inner_optimizer, name)
+
+
+# -- fused softmax+mask (incubate/operators/softmax_mask_fuse.py) -----------
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused op (fused_softmax_mask role —
+    XLA fuses the add into the softmax on TPU; the op exists so traced
+    programs carry the fused node like the reference's)."""
+    from ..ops.dispatch import dispatch
+
+    return dispatch("fused_softmax_mask", {"X": [x], "Mask": [mask]},
+                    {})["Out"][0]
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper-triangle (future positions) masked — the
+    causal-attention fused op (fused_softmax_mask_upper_triangle role)."""
+    from ..ops.dispatch import dispatch
+
+    return dispatch("fused_softmax_mask_upper_triangle", {"X": [x]},
+                    {})["Out"][0]
+
+
+# reference exposes the auto-checkpoint package as incubate.checkpoint
+from . import auto_checkpoint as checkpoint  # noqa: E402,F401
+
+__all__ += ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+            "checkpoint"]

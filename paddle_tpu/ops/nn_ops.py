@@ -758,3 +758,27 @@ def data_norm_kernel(ins, attrs):
     sq_out = decay * ssq + jnp.sum(jnp.square(xs - mean), axis=0)
     return {"Y": y, "BatchSizeOut": size_out, "BatchSumOut": sum_out,
             "BatchSquareSumOut": sq_out}
+
+
+@register_op("fused_softmax_mask")
+def fused_softmax_mask_kernel(ins, attrs):
+    """Parity: fused_softmax_mask_op.cu — softmax(x + mask) fused."""
+    x = ins["X"]
+    s = x.astype(jnp.float32) + ins["Mask"].astype(jnp.float32)
+    s = s - jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s)
+    return {"Out": (e / jnp.sum(e, axis=-1, keepdims=True)).astype(x.dtype)}
+
+
+@register_op("fused_softmax_mask_upper_triangle")
+def fused_softmax_mask_upper_triangle_kernel(ins, attrs):
+    """Parity: fused_softmax_mask_upper_triangle_op.cu — causal softmax:
+    positions j > i get -inf before the softmax."""
+    x = ins["X"]
+    q, k = x.shape[-2], x.shape[-1]
+    mask = jnp.tril(jnp.ones((q, k), bool), k=k - q)
+    s = jnp.where(mask, x.astype(jnp.float32), -1e9)
+    s = s - jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s) * mask
+    return {"Out": (e / jnp.maximum(
+        jnp.sum(e, axis=-1, keepdims=True), 1e-30)).astype(x.dtype)}

@@ -646,6 +646,23 @@ case("softmax_with_cross_entropy", inputs={"Logits": logits, "Label": cl},
      refs={"Loss": -np.log(sm[np.arange(4), cl[:, 0]])[:, None],
            "Softmax": sm},
      grad=("Logits",), atol=1e-4)
+_fsm_x = R(321).randn(2, 3, 4, 4).astype("float32")
+_fsm_m = (R(322).rand(2, 1, 4, 4) < 0.5).astype("float32") * -1e4
+
+
+def _np_softmax_last(v):
+    e = np.exp(v - v.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+case("fused_softmax_mask", inputs={"X": _fsm_x, "Mask": _fsm_m},
+     refs={"Out": _np_softmax_last(_fsm_x + _fsm_m)}, grad=("X",),
+     atol=1e-4)
+_fsm_tri = np.tril(np.ones((4, 4), "float32"))
+_fsm_masked = np.where(_fsm_tri > 0, _fsm_x, -1e9)
+case("fused_softmax_mask_upper_triangle", inputs={"X": _fsm_x},
+     refs={"Out": _np_softmax_last(_fsm_masked) * _fsm_tri}, grad=("X",),
+     atol=1e-4)
 case("label_smooth", inputs={"X": np.eye(3, dtype="float32")},
      attrs={"epsilon": 0.1},
      refs={"Out": np.eye(3) * 0.9 + 0.1 / 3}, grad=("X",))
@@ -922,6 +939,9 @@ EXEMPT = {
     "sequence_conv": "mask-aware numpy parity (test_static_nn)",
     "data_norm": "multi-state accumulator op (test_static_nn "
                  "test_data_norm_accumulates_not_trains)",
+    "quantized_matmul": "int8 execution path — numpy-int8 parity + "
+                        "predictor accuracy contract "
+                        "(test_int8_inference.py)",
 }
 
 # ---------------------------------------------------------------------------
