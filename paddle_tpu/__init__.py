@@ -51,6 +51,7 @@ from .framework.dtype import (  # noqa: F401
 from . import ops  # noqa: F401  (registers all kernels)
 from . import static  # noqa: F401
 
+from . import version  # noqa: F401
 __version__ = "0.1.0"
 
 # Surface modules import UNCONDITIONALLY — a missing module is a loud

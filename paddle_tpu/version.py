@@ -1,0 +1,29 @@
+"""Version info — ``paddle.version`` (reference generates this file at
+build time; ``python/paddle/__init__.py:15`` imports full_version)."""
+
+full_version = "2.1.0+tpu.0.1.0"
+major = "2"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
