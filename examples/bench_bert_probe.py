@@ -1,0 +1,18 @@
+"""BERT-base MLM+NSP throughput probe — thin sweep wrapper over the
+bench.py section (single source of truth for the harness + MFU math)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--k", type=int, default=12)
+    p.add_argument("--inline", action="store_true")
+    args = p.parse_args()
+    r = bench._bert_bench(batch=args.batch, k=args.k, inline=args.inline)
+    print(r)

@@ -93,6 +93,21 @@ _NONJIT = frozenset({"where_index", "unique", "masked_select", "bincount", "hist
 
 _jit_cache: Dict[Any, Any] = {}
 
+# When True, kernels run inline (no per-op inner-jit wrapper) so the whole
+# traced program is ONE flat jaxpr.  Measured: the inner-jit grouping wins
+# on transformers (+4.4 MFU GPT, +5.7 BERT) and is neutral on ResNet-50
+# (XLA reaches the same conv+BN+ReLU fusion either way) — so False is the
+# right default; the toggle exists for per-workload experiments.
+_INLINE_KERNELS = False
+
+
+def set_inline_kernels(flag: bool) -> bool:
+    """Toggle per-op inner-jit wrapping; returns the previous value."""
+    global _INLINE_KERNELS
+    old = _INLINE_KERNELS
+    _INLINE_KERNELS = bool(flag)
+    return old
+
 
 _HAS_ABSTRACT_MESH = hasattr(jax.sharding, "get_abstract_mesh")
 
@@ -123,7 +138,7 @@ def run_eager_kernel(op_type: str, ins: Dict[str, List[Any]], attrs: Dict[str, A
     # Under plain jit/grad the inner-jit wrapper is KEPT deliberately: the
     # nested pjit boundaries guide XLA's fusion grouping — measured +4.4 MFU
     # points on the GPT bench vs inlining every op into one flat jaxpr.
-    if _in_manual_mesh_context(ins, rng):
+    if _INLINE_KERNELS or _in_manual_mesh_context(ins, rng):
         return registry.run_kernel(op_def, ins, attrs, rng=rng)
     try:
         key = (op_type, registry._freeze(attrs))

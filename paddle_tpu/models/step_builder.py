@@ -1,0 +1,163 @@
+"""Generic one-jit functional train step for any dygraph model.
+
+Role parity: the reference's ``Executor.run`` over a ``CompiledProgram``
+with fused optimizer ops (``/root/reference/python/paddle/fluid/
+executor.py``) — here the whole fwd+bwd+update is ONE donated XLA
+program, the same design :func:`models.gpt.build_functional_train_step`
+uses for the flagship, generalized so ResNet/BERT/any ``nn.Layer`` can be
+driven at full device speed (bench.py resnet50 / bert_base sections).
+
+TPU-first mechanics:
+  * parameters stored fp32 (they double as optimizer masters) and cast
+    to ``compute_dtype`` (bf16) at use — XLA fuses the converts into the
+    consuming conv/matmul, so no second weight copy lives in HBM;
+  * non-trainable buffers (BatchNorm running stats) are threaded through
+    the step functionally: swapped in before the traced forward, their
+    post-forward values returned as the new buffer state (the reference
+    mutates the ``Variable`` in place inside the op — here state is
+    explicit so the program stays pure and donatable);
+  * momentum-SGD and AdamW updates run inside the same jit, donated.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["build_model_train_step"]
+
+
+def build_model_train_step(
+    model,
+    loss_builder: Callable,
+    *,
+    optimizer: str = "momentum",
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    compute_dtype: Optional[str] = "bfloat16",
+    dp_axis: str = "dp",
+    inline_kernels: bool = False,
+):
+    """Compile fwd+bwd+optimizer into one donated XLA program.
+
+    ``loss_builder(model, *batch_tensors) -> Tensor`` runs the eager-style
+    forward + loss under the tracer (grad tape off — autodiff is
+    ``jax.value_and_grad`` over the pure function).
+
+    Returns ``(step_fn, params, buffers, opt_state)`` with
+    ``step_fn(params, buffers, opt_state, *batch_arrays) ->
+    (params, buffers, opt_state, loss)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..distributed import mesh as mesh_mod
+    from ..dygraph import tracer
+    from ..dygraph.tensor import Tensor
+
+    model.train()
+    param_objs = [p for p in model.parameters()
+                  if not getattr(p, "stop_gradient", False)]
+    buf_sites = []
+    for layer in model.sublayers(include_self=True):
+        for name in list(layer._buffers):
+            buf_sites.append((layer, name))
+
+    import jax.numpy as _jnp
+
+    # COPY the arrays: step_jit donates its inputs, and donating the model's
+    # own live buffers would leave the Layer holding deleted arrays after the
+    # first step (TPU-only failure — donation is a no-op on CPU).  The model
+    # stays a valid template at its initial weights; the TRAINING state lives
+    # in the returned (params, buffers, opt_state).
+    params = [_jnp.array(p._array) for p in param_objs]
+    buffers = [_jnp.array(layer._buffers[name]._array)
+               for layer, name in buf_sites]
+
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def _to_compute(a):
+        if cd is not None and a.dtype != cd and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(cd)
+        return a
+
+    mesh = mesh_mod.get_mesh()
+
+    def _constrain_dp(x):
+        if mesh is not None and mesh_mod.axis_size(dp_axis) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(dp_axis)))
+        return x
+
+    def run_loss(param_arrays, buf_arrays, batch):
+        old_p = [p._array for p in param_objs]
+        old_b = [layer._buffers[name] for layer, name in buf_sites]
+        for p, a in zip(param_objs, param_arrays):
+            p._array = _to_compute(a)
+        for (layer, name), a in zip(buf_sites, buf_arrays):
+            layer._buffers[name] = Tensor(a, stop_gradient=True)
+        og = tracer.set_grad_enabled(False)
+        # inner-jit grouping wins on transformers and is neutral on conv
+        # nets (measured, tracer._INLINE_KERNELS) — default keeps it
+        oi = tracer.set_inline_kernels(inline_kernels)
+        try:
+            inputs = [Tensor(_constrain_dp(_to_compute(a))
+                             if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                             stop_gradient=True) for a in batch]
+            loss = loss_builder(model, *inputs)
+            new_bufs = [layer._buffers[name]._array for layer, name in buf_sites]
+            return loss._array.astype(jnp.float32), new_bufs
+        finally:
+            tracer.set_grad_enabled(og)
+            tracer.set_inline_kernels(oi)
+            for p, a in zip(param_objs, old_p):
+                p._array = a
+            for (layer, name), t in zip(buf_sites, old_b):
+                layer._buffers[name] = t
+
+    if optimizer == "momentum":
+        opt_state = {"v": [jnp.zeros(p.shape, jnp.float32) for p in params],
+                     "t": jnp.zeros((), jnp.int32)}
+    elif optimizer == "adamw":
+        opt_state = {"m": [jnp.zeros(p.shape, jnp.float32) for p in params],
+                     "v": [jnp.zeros(p.shape, jnp.float32) for p in params],
+                     "t": jnp.zeros((), jnp.int32)}
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+
+    def step(params, buffers, opt_state, *batch):
+        (loss, new_bufs), grads = jax.value_and_grad(
+            run_loss, has_aux=True)(params, buffers, batch)
+        t = opt_state["t"] + 1
+        new_p = []
+        if optimizer == "momentum":
+            new_v = []
+            for p, g, v in zip(params, grads, opt_state["v"]):
+                gf = g.astype(jnp.float32) + weight_decay * p
+                v2 = momentum * v + gf
+                new_p.append(p - lr * v2)
+                new_v.append(v2)
+            new_state = {"v": new_v, "t": t}
+        else:
+            b1t = 1.0 - beta1 ** t.astype(jnp.float32)
+            b2t = 1.0 - beta2 ** t.astype(jnp.float32)
+            new_m, new_v = [], []
+            for p, g, m, v in zip(params, grads, opt_state["m"], opt_state["v"]):
+                gf = g.astype(jnp.float32)
+                m2 = beta1 * m + (1 - beta1) * gf
+                v2 = beta2 * v + (1 - beta2) * jnp.square(gf)
+                upd = (m2 / b1t) / (jnp.sqrt(v2 / b2t) + eps) + weight_decay * p
+                new_p.append(p - lr * upd)
+                new_m.append(m2)
+                new_v.append(v2)
+            new_state = {"m": new_m, "v": new_v, "t": t}
+        return new_p, new_bufs, new_state, loss
+
+    step_jit = jax.jit(step, donate_argnums=(0, 1, 2))
+    return step_jit, params, buffers, opt_state

@@ -224,6 +224,13 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ks = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
     st = ks if stride is None else ([stride] * 2 if isinstance(stride, int) else list(stride))
     pd = [padding] * 2 if isinstance(padding, int) else list(padding)
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask=True requires NCHW (pool_with_index_op parity)")
+        outs = dispatch("max_pool2d_with_index", {"X": [x]},
+                        {"ksize": ks, "strides": st, "paddings": pd,
+                         "ceil_mode": ceil_mode})
+        return single(outs, "Out"), single(outs, "Mask")
     return _d(
         "pool2d", {"X": [x]},
         {"pooling_type": "max", "ksize": ks, "strides": st, "paddings": pd,
@@ -251,8 +258,15 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     )
 
 
-def adaptive_max_pool2d(x, output_size, data_format="NCHW", name=None):
+def adaptive_max_pool2d(x, output_size, return_mask=False,
+                        data_format="NCHW", name=None):
     os = [output_size] * 2 if isinstance(output_size, int) else list(output_size)
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask=True requires NCHW (pool_with_index_op parity)")
+        outs = dispatch("max_pool2d_with_index", {"X": [x]},
+                        {"ksize": os, "adaptive": True})
+        return single(outs, "Out"), single(outs, "Mask")
     return _d(
         "pool2d", {"X": [x]},
         {"pooling_type": "max", "ksize": os, "adaptive": True, "data_format": data_format},

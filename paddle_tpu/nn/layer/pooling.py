@@ -14,9 +14,13 @@ class MaxPool2D(Layer):
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.return_mask = return_mask
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode, return_mask=self.return_mask,
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):
@@ -28,28 +32,37 @@ class AvgPool2D(Layer):
         self.padding = padding
         self.ceil_mode = ceil_mode
         self.exclusive = exclusive
+        self.data_format = data_format
 
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, self.exclusive)
+                            self.ceil_mode, self.exclusive,
+                            data_format=self.data_format)
 
 
 class AdaptiveAvgPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW", name=None):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):
-    def __init__(self, output_size, return_mask=False, name=None):
+    def __init__(self, output_size, return_mask=False, data_format="NCHW",
+                 name=None):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size,
+                                     return_mask=self.return_mask,
+                                     data_format=self.data_format)
 
 
 class MaxPool1D(Layer):

@@ -757,6 +757,15 @@ case("pool2d", inputs={"X": px},
      attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
             "paddings": [0, 0], "global_pooling": True},
      refs={"Out": px.mean((2, 3), keepdims=True)}, tag="global")
+_pl = (px.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+       .reshape(1, 2, 2, 2, 4).argmax(-1))  # window-local argmax 0..3
+case("max_pool2d_with_index", inputs={"X": px},
+     attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+     refs={"Out": px.reshape(1, 2, 2, 2, 2, 2).max((3, 5)),
+           # global h*W+w: 8*oy + 2*ox + 4*(l//2) + l%2 on the 4x4 map
+           "Mask": (4 * (_pl // 2) + _pl % 2
+                    + np.array([[0, 2], [8, 10]])).astype("int32")},
+     grad=("X",))
 ix = R(44).randn(1, 1, 2, 2).astype("float32")
 case("nearest_interp_v2", inputs={"X": ix},
      attrs={"out_h": 4, "out_w": 4, "data_layout": "NCHW"},

@@ -1,0 +1,193 @@
+"""Generic functional train step + NHWC vision path (bench.py's engine).
+
+Covers: models/step_builder.py, the pool2d NHWC layout fix, the ResNet
+data_format plumbing, and pins the MAC count bench.py uses for the
+ResNet-50 MFU denominator.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import tensor_api as T
+from paddle_tpu.nn import functional as F
+
+
+def _ce_loss(m, images, labels):
+    return T.mean(F.softmax_with_cross_entropy(m(images), labels))
+
+
+def test_step_builder_momentum_resnet_buffers_update():
+    from paddle_tpu.models.step_builder import build_model_train_step
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    model = resnet18(num_classes=10)
+    step, params, bufs, opt = build_model_train_step(
+        model, _ce_loss, optimizer="momentum", lr=0.05, compute_dtype=None)
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(4, 3, 64, 64).astype("float32")
+    labels = rng.randint(0, 10, (4, 1)).astype("int64")
+    bufs0 = [np.asarray(b).copy() for b in bufs]
+    losses = []
+    for _ in range(4):
+        params, bufs, opt, loss = step(params, bufs, opt, imgs, labels)
+        losses.append(float(np.asarray(loss)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # BN running stats moved (functional buffer threading)
+    assert any(np.abs(np.asarray(b) - b0).max() > 0
+               for b, b0 in zip(bufs, bufs0))
+
+
+def test_step_builder_adamw_matches_eager_trajectory():
+    """One-jit AdamW step == eager tape + optimizer.AdamW, same init."""
+    from paddle_tpu.models.step_builder import build_model_train_step
+    import paddle_tpu.optimizer as popt
+
+    class Tiny(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 8).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+
+    paddle.seed(3)
+    m1 = Tiny()
+    step, params, bufs, opt = build_model_train_step(
+        m1, _ce_loss, optimizer="adamw", lr=1e-2, weight_decay=0.0,
+        compute_dtype=None)
+    f_losses = []
+    for _ in range(3):
+        params, bufs, opt, loss = step(params, bufs, opt, x, y)
+        f_losses.append(float(np.asarray(loss)))
+
+    paddle.seed(3)
+    m2 = Tiny()
+    o = popt.AdamW(learning_rate=1e-2, parameters=m2.parameters(),
+                   weight_decay=0.0)
+    e_losses = []
+    for _ in range(3):
+        loss = _ce_loss(m2, paddle.to_tensor(x), paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        e_losses.append(float(loss.numpy()))
+    np.testing.assert_allclose(f_losses, e_losses, rtol=2e-5, atol=2e-5)
+
+
+def test_resnet_nhwc_matches_nchw():
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    a = resnet18(num_classes=10)
+    a.eval()
+    paddle.seed(0)
+    b = resnet18(num_classes=10, data_format="NHWC")
+    b.eval()
+    x = np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32")
+    ya = a(paddle.to_tensor(x)).numpy()
+    yb = b(paddle.to_tensor(np.ascontiguousarray(
+        x.transpose(0, 2, 3, 1)))).numpy()
+    np.testing.assert_allclose(ya, yb, rtol=1e-5, atol=1e-5)
+
+
+def test_pool2d_nhwc_layouts():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    xh = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    for fn, kw in [
+        (F.max_pool2d, dict(kernel_size=2, stride=2)),
+        (F.avg_pool2d, dict(kernel_size=2, stride=2)),
+        (F.max_pool2d, dict(kernel_size=3, stride=2, padding=1)),
+        (F.adaptive_avg_pool2d, dict(output_size=1)),
+        (F.adaptive_avg_pool2d, dict(output_size=2)),
+        (F.adaptive_max_pool2d, dict(output_size=2)),
+    ]:
+        a = fn(paddle.to_tensor(x), **kw).numpy()
+        b = fn(paddle.to_tensor(xh), data_format="NHWC", **kw).numpy()
+        np.testing.assert_allclose(a, b.transpose(0, 3, 1, 2), rtol=1e-6,
+                                   atol=1e-6, err_msg=str((fn, kw)))
+
+
+def test_max_pool2d_ceil_mode_and_mask():
+    import torch
+
+    x = np.random.RandomState(0).randn(2, 3, 7, 7).astype("float32")
+    # ceil_mode output shape + values vs torch
+    out = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, ceil_mode=True)
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 3, stride=2,
+                                         ceil_mode=True).numpy()
+    assert out.shape == list(ref.shape)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    # return_mask: flat h*W+w argmax indices (pool_with_index parity)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                             return_mask=True)
+    rout, rmask = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), rout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), rmask.numpy())
+    # adaptive variant
+    out, mask = F.adaptive_max_pool2d(paddle.to_tensor(x[:, :, :6, :6]), 2,
+                                      return_mask=True)
+    rout, rmask = torch.nn.functional.adaptive_max_pool2d(
+        torch.tensor(x[:, :, :6, :6]), 2, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), rout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), rmask.numpy())
+    # gradient flows to the argmax positions
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    o, _ = F.max_pool2d(t, 2, stride=2, return_mask=True)
+    T.sum(o).backward()
+    tt = torch.tensor(x, requires_grad=True)
+    to, _ = torch.nn.functional.max_pool2d(tt, 2, stride=2, return_indices=True)
+    to.sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), tt.grad.numpy(), rtol=1e-6)
+
+
+def test_batch_norm_large_mean_no_cancellation():
+    """Shifted one-pass variance survives |mean| >> std (raw E[x^2]-E[x]^2
+    in f32 loses all variance bits at |mean|/std ~ 3e3)."""
+    rng = np.random.RandomState(0)
+    x = (rng.randn(8, 4, 6, 6) + 1e4).astype("float32")
+    bn = nn.BatchNorm2D(4)
+    bn.train()
+    y = bn(paddle.to_tensor(x)).numpy()
+    mean = x.astype("float64").mean(axis=(0, 2, 3))
+    var = x.astype("float64").var(axis=(0, 2, 3))
+    ref = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+        var.reshape(1, -1, 1, 1) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=5e-2, atol=5e-2)
+    assert np.abs(y.std() - 1.0) < 0.05
+
+
+def test_batch_norm_one_pass_stats_match_numpy():
+    x = np.random.RandomState(0).randn(4, 3, 5, 5).astype("float32") * 3 + 1
+    bn = nn.BatchNorm2D(3)
+    bn.train()
+    y = bn(paddle.to_tensor(x)).numpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+        var.reshape(1, -1, 1, 1) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        bn._buffers["_mean"].numpy(), 0.1 * mean, rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_macs_constant_pinned():
+    """bench.py's MFU denominator == hapi.flops on the real model."""
+    from paddle_tpu.hapi.dynamic_flops import flops
+    from paddle_tpu.vision.models import resnet50
+
+    zero = lambda l, x, y: 0
+    n = flops(resnet50(), [1, 3, 224, 224], custom_ops={
+        nn.ReLU: zero, nn.BatchNorm2D: zero, nn.MaxPool2D: zero,
+        nn.AdaptiveAvgPool2D: zero})
+    assert n == 4089184256
