@@ -107,6 +107,8 @@ from .dygraph.parallel import DataParallel  # noqa: F401
 
 # -- top-level surface completeness (reference python/paddle/__init__.py) --
 from . import hub  # noqa: F401
+from . import fluid  # noqa: F401  (v2.1 compat namespace; reference
+#                     python/paddle/__init__.py re-exports fluid too)
 from .nn import ParamAttr  # noqa: F401
 from .framework.dtype import DataType as dtype  # noqa: F401
 from .framework.place import NPUPlace  # noqa: F401

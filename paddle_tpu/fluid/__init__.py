@@ -1,0 +1,110 @@
+"""``paddle.fluid`` — the pre-2.x compatibility namespace.
+
+Parity: ``/root/reference/python/paddle/fluid/__init__.py`` (the reference's
+public surface re-exports fluid, and v2.1-era model code — the
+PaddleClas/PaddleNLP generations the BASELINE configs name — writes
+``import paddle.fluid as fluid``).  Every name maps onto the 2.x TPU
+implementations; nothing here is a second implementation.
+"""
+
+from __future__ import annotations
+
+# -- framework ---------------------------------------------------------------
+from ..framework.program import (  # noqa: F401
+    Program, Variable, default_main_program, default_startup_program,
+    program_guard, in_dygraph_mode, name_scope,
+)
+from ..framework import unique_name  # noqa: F401
+from ..framework.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace,
+)
+from ..static import cpu_places, cuda_places  # noqa: F401
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static import WeightNormParamAttr  # noqa: F401
+
+# -- executor ----------------------------------------------------------------
+from ..static.executor import Executor  # noqa: F401
+from ..framework.scope import Scope, global_scope, scope_guard  # noqa: F401
+
+# -- static graph pieces -----------------------------------------------------
+from ..static import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy, ParallelExecutor,
+    append_backward, gradients,
+)
+from ..static.input import data  # noqa: F401
+
+# -- submodules --------------------------------------------------------------
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import io  # noqa: F401
+from . import nets  # noqa: F401
+from . import metrics  # noqa: F401
+from . import core  # noqa: F401
+from . import framework  # noqa: F401
+from . import executor  # noqa: F401
+from . import backward  # noqa: F401
+from . import param_attr  # noqa: F401
+from . import contrib  # noqa: F401
+
+from .layers import embedding, one_hot  # noqa: F401  (fluid.embedding alias)
+
+
+def enable_dygraph(place=None):
+    from ..framework import program as fw
+
+    fw.disable_static()
+
+
+def disable_dygraph():
+    from ..framework import program as fw
+
+    fw.enable_static()
+
+
+def enable_imperative(place=None):
+    enable_dygraph(place)
+
+
+def disable_imperative():
+    disable_dygraph()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def get_flags(flags):
+    from ..framework import flags as _f
+
+    if isinstance(flags, str):
+        flags = [flags]
+    return {name: _f.flag(name) for name in flags}
+
+
+def set_flags(flags_dict):
+    from ..framework import flags as _f
+
+    for name, value in flags_dict.items():
+        _f.set_flag(name, value)
+
+
+def memory_optimize(*a, **k):
+    """No-op: XLA owns buffer liveness (reference transpiler-era pass)."""
+
+
+def release_memory(*a, **k):
+    """No-op: XLA owns buffer liveness."""
+
+
+def require_version(min_version, max_version=None):
+    return None
+
+
+def load_op_library(*a, **k):
+    raise NotImplementedError(
+        "fluid.load_op_library loads CUDA .so custom ops; use "
+        "paddle_tpu.utils.cpp_extension (C++ + pure_callback) instead.")

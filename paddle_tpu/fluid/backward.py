@@ -1,0 +1,6 @@
+"""``paddle.fluid.backward`` module alias.
+
+Parity: ``/root/reference/python/paddle/fluid/backward.py``.
+"""
+
+from ..static.backward import append_backward, gradients  # noqa: F401

@@ -1,0 +1,18 @@
+"""``paddle.fluid.clip`` (GradientClipBy* → 2.x nn clip classes).
+
+Parity: ``/root/reference/python/paddle/fluid/clip.py``.
+"""
+
+from ..nn import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
+
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+GradientClipByNorm = ClipGradByNorm
+GradientClipByValue = ClipGradByValue
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    raise NotImplementedError(
+        "fluid.clip.set_gradient_clip was deprecated in the reference too; "
+        "pass grad_clip=... to the optimizer instead.")

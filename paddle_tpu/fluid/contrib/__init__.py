@@ -1,0 +1,9 @@
+"""``paddle.fluid.contrib`` — the slim/quant + mixed-precision entries
+v2.1 user code touches.
+
+Parity: ``/root/reference/python/paddle/fluid/contrib/`` (slim.quantization
+and mixed_precision are the surviving users; the rest was PS-era).
+"""
+
+from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401

@@ -1,0 +1,16 @@
+"""fluid.contrib.mixed_precision — AMP decorate/Config for v2.1 scripts.
+
+Parity: ``/root/reference/python/paddle/fluid/contrib/mixed_precision/``
+(decorate + CustomOpLists); maps onto the 2.x static AMP rewrite.
+"""
+
+from ....amp import GradScaler, auto_cast, decorate  # noqa: F401
+
+
+class CustomOpLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(custom_white_list or [])
+        self.black_list = set(custom_black_list or [])
+
+
+AutoMixedPrecisionLists = CustomOpLists

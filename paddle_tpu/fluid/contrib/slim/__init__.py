@@ -1,0 +1,7 @@
+"""fluid.contrib.slim — quantization entries (QAT/PTQ).
+
+Parity: ``/root/reference/python/paddle/fluid/contrib/slim/quantization``;
+maps to the 2.x incubate.quant implementations.
+"""
+
+from . import quantization  # noqa: F401

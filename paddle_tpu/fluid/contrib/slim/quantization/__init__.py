@@ -1,0 +1,9 @@
+"""fluid.contrib.slim.quantization — ImperativeQuantAware / PTQ.
+
+Parity: ``imperative/qat.py`` + ``imperative/ptq.py`` under the reference's
+``fluid/contrib/slim/quantization``.
+"""
+
+from .....incubate.quant import (  # noqa: F401
+    ImperativePTQ, ImperativeQuantAware,
+)

@@ -1,0 +1,64 @@
+"""``paddle.fluid.core`` shim — the pybind module's commonly-touched names.
+
+Parity role: ``/root/reference/python/paddle/fluid/core.py`` (loads the
+C++ pybind .so).  User code mostly touches places, device counts, and a
+few feature probes; those are mapped here.  Anything else raises with
+guidance instead of AttributeError.
+"""
+
+from __future__ import annotations
+
+from ..framework.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, Place, TPUPlace,
+    XPUPlace, is_compiled_with_cuda, is_compiled_with_npu,
+    is_compiled_with_xpu,
+)
+
+
+def get_cuda_device_count() -> int:
+    return 0
+
+
+def get_tpu_device_count() -> int:
+    import jax
+
+    try:
+        return len([d for d in jax.devices() if d.platform == "tpu"])
+    except Exception:
+        return 0
+
+
+def is_compiled_with_mkldnn() -> bool:
+    return False
+
+
+def is_compiled_with_brpc() -> bool:
+    return False
+
+
+def is_compiled_with_dist() -> bool:
+    return True  # jax.distributed-backed collectives
+
+
+class VarDesc:
+    class VarType:
+        FP16 = "float16"
+        BF16 = "bfloat16"
+        FP32 = "float32"
+        FP64 = "float64"
+        INT8 = "int8"
+        INT16 = "int16"
+        INT32 = "int32"
+        INT64 = "int64"
+        BOOL = "bool"
+        UINT8 = "uint8"
+        LOD_TENSOR = "lod_tensor"
+        SELECTED_ROWS = "selected_rows"
+        LOD_TENSOR_ARRAY = "lod_tensor_array"
+
+
+def __getattr__(name):  # noqa: N807
+    raise NotImplementedError(
+        f"fluid.core.{name}: the C++ pybind internals are replaced by the "
+        "XLA runtime in the TPU-native build; use the public paddle API "
+        "for this capability.")

@@ -1,0 +1,7 @@
+"""``paddle.fluid.executor`` module alias.
+
+Parity: ``/root/reference/python/paddle/fluid/executor.py``.
+"""
+
+from ..framework.scope import Scope, global_scope, scope_guard  # noqa: F401
+from ..static.executor import Executor  # noqa: F401

@@ -1,0 +1,27 @@
+"""``paddle.fluid.initializer`` — v2.1 initializer names.
+
+Parity: ``/root/reference/python/paddle/fluid/initializer.py`` (Constant,
+Uniform, Normal, TruncatedNormal, Xavier, Bilinear, MSRA + the *Initializer
+aliases and set_global_initializer).
+"""
+
+from ..nn import initializer as _init
+
+Constant = ConstantInitializer = _init.Constant
+Uniform = UniformInitializer = _init.Uniform
+Normal = NormalInitializer = _init.Normal
+TruncatedNormal = TruncatedNormalInitializer = _init.TruncatedNormal
+Xavier = XavierInitializer = _init.XavierNormal
+MSRA = MSRAInitializer = _init.KaimingNormal
+Bilinear = BilinearInitializer = getattr(_init, "Bilinear", None)
+NumpyArrayInitializer = _init.Assign
+
+set_global_initializer = _init.set_global_initializer
+
+if Bilinear is None:
+    def _bilinear_unavailable(*a, **k):
+        raise NotImplementedError(
+            "Bilinear initializer: initialize conv-transpose weights with "
+            "an explicit numpy kernel + initializer.Assign")
+
+    Bilinear = BilinearInitializer = _bilinear_unavailable

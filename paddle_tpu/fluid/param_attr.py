@@ -1,0 +1,7 @@
+"""``paddle.fluid.param_attr`` module alias.
+
+Parity: ``/root/reference/python/paddle/fluid/param_attr.py``.
+"""
+
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static import WeightNormParamAttr  # noqa: F401

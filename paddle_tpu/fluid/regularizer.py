@@ -1,0 +1,9 @@
+"""``paddle.fluid.regularizer`` (L1Decay/L2Decay + *Regularizer aliases).
+
+Parity: ``/root/reference/python/paddle/fluid/regularizer.py``.
+"""
+
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

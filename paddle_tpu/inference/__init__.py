@@ -102,15 +102,25 @@ class Config:
         self._amp = ("bfloat16" if precision_mode in
                      (PrecisionType.Half, PrecisionType.Bfloat16) else None)
         if precision_mode == PrecisionType.Int8:
+            # TRT-engine parity path: the user explicitly chose the int8
+            # engine, so no size gate (TRT's own min_subgraph_size governs
+            # granularity there); enable_int8() keeps the measured gate
             self._int8 = True
+            self._int8_min_elements = 0
 
     def enable_bf16(self):
         self._amp = "bfloat16"
 
-    def enable_int8(self):
-        """Execute weight matmuls as int8 x int8 -> int32 on the MXU
-        (static/quant_int8.py rewrite; the TRT int8 engine role)."""
+    def enable_int8(self, min_weight_elements: int = 1 << 16):
+        """Execute weight matmuls/convs as int8 x int8 -> int32 on the MXU
+        (static/quant_int8.py rewrite; the TRT int8 engine role).
+
+        ``min_weight_elements`` keeps small, bandwidth-bound layers on the
+        bf16 path — the int8 win (1.5x measured at 4096^3, BENCH extras)
+        needs enough MACs to amortize the quantize/dequant passes.  Pass 0
+        to quantize everything."""
         self._int8 = True
+        self._int8_min_elements = int(min_weight_elements)
 
     def summary(self):
         return {"model": self._prefix, "device": self._device,
@@ -173,7 +183,9 @@ class Predictor:
 
             self._n_int8 = rewrite_program_int8(
                 self._program, self._scope,
-                fetch_names=list(self._fetch_names))
+                fetch_names=list(self._fetch_names),
+                min_weight_elements=getattr(
+                    config, "_int8_min_elements", 1 << 16))
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
 

@@ -449,10 +449,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ast.Assign(targets=[_name(tv, ast.Store())], value=step),
             ast.Assign(targets=[_name(i, ast.Store())], value=_name(sv)),
         ]
-        # i < stop (positive step assumed for the symbolic path; negative
-        # Python steps still work because the while runs in Python then)
-        test = ast.Compare(left=_name(i), ops=[ast.Lt()],
-                           comparators=[_name(ev)])
+        # step-sign-aware loop test: `i < stop if step > 0 else i > stop`
+        # (a bare `i < stop` silently runs ZERO iterations for a negative
+        # step — round-4 advisor finding).  For the common symbolic case
+        # the step is still a Python int, so the ternary resolves at
+        # trace time; a Tensor-valued step hits the Tensor-__bool__ guard
+        # with its standard error message.
+        test = ast.IfExp(
+            test=ast.Compare(left=_name(tv), ops=[ast.Gt()],
+                             comparators=[ast.Constant(value=0)]),
+            body=ast.Compare(left=_name(i), ops=[ast.Lt()],
+                             comparators=[_name(ev)]),
+            orelse=ast.Compare(left=_name(i), ops=[ast.Gt()],
+                               comparators=[_name(ev)]))
         bump = ast.Assign(
             targets=[_name(i, ast.Store())],
             value=ast.BinOp(left=_name(i), op=ast.Add(), right=_name(tv)))
@@ -471,14 +480,32 @@ _CONVERT_CACHE = {}
 
 def convert_func(fn: Callable) -> Callable:
     """Return ``fn`` rewritten for data-dependent control flow, or ``fn``
-    unchanged when there is nothing to convert / no source available."""
-    key = getattr(fn, "__code__", None)
-    if key is None:
+    unchanged when there is nothing to convert / no source available.
+
+    Cache discipline: closure-free functions cache per code object; a
+    function WITH free variables caches on the function object itself —
+    factory-made functions share one code object across different closure
+    cells (e.g. the generated activation forwards), so a code-keyed cache
+    would silently hand one factory instance another instance's conversion
+    (round-4 advisor finding).  Closure values are resolved at conversion
+    time; mutating a cell after conversion is not reflected."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
         return fn
-    if key in _CONVERT_CACHE:
-        return _CONVERT_CACHE[key]
+    if not code.co_freevars:
+        if code in _CONVERT_CACHE:
+            return _CONVERT_CACHE[code]
+        converted = _convert_uncached(fn)
+        _CONVERT_CACHE[code] = converted
+        return converted
+    cached = getattr(fn, "__dy2static_conv__", None)
+    if cached is not None:
+        return cached
     converted = _convert_uncached(fn)
-    _CONVERT_CACHE[key] = converted
+    try:
+        fn.__dy2static_conv__ = converted
+    except (AttributeError, TypeError):
+        pass
     return converted
 
 

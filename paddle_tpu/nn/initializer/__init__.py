@@ -22,7 +22,7 @@ from ...framework.dtype import convert_dtype
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "calculate_gain",
+    "Assign", "calculate_gain", "set_global_initializer",
 ]
 
 
@@ -227,3 +227,25 @@ class Assign(Initializer):
 
 # aliases matching reference naming (initializer.py MSRAInitializer etc.)
 MSRA = KaimingNormal
+
+
+# ---------------------------------------------------------------------------
+# global default initializers
+# ---------------------------------------------------------------------------
+
+_global_weight_initializer: Optional[Initializer] = None
+_global_bias_initializer: Optional[Initializer] = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: ``paddle.nn.initializer.set_global_initializer``
+    (reference ``fluid/initializer.py:set_global_initializer``) — default
+    initializers for parameters created AFTER this call whose attr does not
+    pin one.  Pass ``None`` to restore the built-in defaults."""
+    global _global_weight_initializer, _global_bias_initializer
+    _global_weight_initializer = weight_init
+    _global_bias_initializer = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _global_bias_initializer if is_bias else _global_weight_initializer

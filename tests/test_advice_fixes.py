@@ -214,3 +214,75 @@ def test_master_weight_lazy_restore():
     np.testing.assert_array_equal(np.asarray(mw._array), master_saved)
     # and NOT equal to a plain upcast of the lossy bf16 param (generically)
     assert f"{wname}_master_weight_0" not in o2._accumulators_holder
+
+
+# ---------------------------------------------------------------------------
+# round-4 advisor findings
+# ---------------------------------------------------------------------------
+
+
+def test_dy2static_negative_step_range():
+    """Converted `for i in range(start, stop, step)` must honor a negative
+    step (advisor HIGH: the synthesized `while i < stop` ran 0 iterations)."""
+    from paddle_tpu.jit import dy2static
+
+    def acc_down(x):
+        total = x * 0.0
+        for i in range(3, 0, -1):
+            total = total + float(i) * x
+        return total
+
+    def acc_up(x):
+        total = x * 0.0
+        for i in range(1, 4):
+            total = total + float(i) * x
+        return total
+
+    conv_d = dy2static.convert_func(acc_down)
+    conv_u = dy2static.convert_func(acc_up)
+    x = paddle.to_tensor(np.asarray(1.0, "float32"))
+    assert float(conv_d(x).numpy()) == 6.0
+    assert float(conv_u(x).numpy()) == 6.0
+
+
+def test_dy2static_cache_not_shared_across_closures():
+    """Factory-made functions share one code object with different closure
+    cells; the conversion cache must not hand one instance another's
+    conversion (advisor MEDIUM — the ReLU-for-Tanh jit.save corruption)."""
+    from paddle_tpu.jit import dy2static
+
+    def make(k):
+        def f(x):
+            if False:
+                pass  # force a conversion (contains an If)
+            return x * k
+
+        return f
+
+    f10 = dy2static.convert_func(make(10.0))
+    f2 = dy2static.convert_func(make(2.0))
+    x = paddle.to_tensor(np.asarray(3.0, "float32"))
+    assert float(f10(x).numpy()) == 30.0
+    assert float(f2(x).numpy()) == 6.0
+
+
+def test_jit_save_unpoisoned_by_prior_factory_layer_trace(tmp_path):
+    """End-to-end regression for the 622/623 full-suite failure: tracing a
+    ReLU net first must not corrupt a later Tanh net's saved program."""
+    from paddle_tpu import jit
+
+    paddle.seed(3)
+    relu_net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    relu_net.eval()
+    jit.save(relu_net, str(tmp_path / "a" / "m"),
+             input_spec=[jit.InputSpec([4, 8], "float32", "x")])
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+    net.eval()
+    x = paddle.randn([2, 6])
+    expected = net(x).numpy()
+    path = str(tmp_path / "b" / "m")
+    jit.save(net, path, input_spec=[jit.InputSpec([-1, 6], "float32")])
+    got = jit.load(path)(x).numpy()
+    np.testing.assert_allclose(expected, got, rtol=1e-5, atol=1e-6)

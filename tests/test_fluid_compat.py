@@ -1,0 +1,202 @@
+"""``paddle.fluid`` compat namespace (round-4 verdict missing #1).
+
+A v2.1-era script must run unmodified: fluid.layers builders + Executor
+feed/fetch, fluid.dygraph guard/Layer classes, fluid.optimizer
+*Optimizer names, fluid.metrics accumulators, and informative raises for
+the PS-era names.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_namespace_reachable_from_paddle():
+    assert paddle.fluid is fluid
+    for sub in ("layers", "dygraph", "io", "optimizer", "initializer",
+                "regularizer", "clip", "nets", "metrics", "core",
+                "framework", "executor", "backward", "param_attr",
+                "contrib"):
+        assert hasattr(fluid, sub), sub
+
+
+def test_fluid_static_mnist_slice_trains():
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[1, 12, 12])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            conv = fluid.nets.simple_img_conv_pool(
+                img, filter_size=3, num_filters=4, pool_size=2,
+                pool_stride=2, act="relu")
+            pred = fluid.layers.fc(conv, size=4, activation="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            acc = fluid.layers.accuracy(input=pred, label=label)
+            opt = fluid.optimizer.AdamOptimizer(learning_rate=5e-3)
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(15):
+            y = rng.randint(0, 4, (16,))
+            x = rng.rand(16, 1, 12, 12).astype("float32") * 0.2
+            for i, k in enumerate(y):
+                r, c = divmod(int(k), 2)
+                x[i, 0, r * 6:(r + 1) * 6, c * 6:(c + 1) * 6] += 1.0
+            lv, _ = exe.run(main, feed={"img": x, "label": y.reshape(-1, 1)},
+                            fetch_list=[loss, acc])
+            losses.append(float(lv))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_layers_data_append_batch_size():
+    paddle.enable_static()
+    try:
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            v = fluid.layers.data("a", shape=[3, 4])
+            assert list(v.shape) == [-1, 3, 4]
+            w = fluid.layers.data("b", shape=[-1, 5], append_batch_size=False)
+            assert list(w.shape) == [-1, 5]
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_dygraph_guard_and_layers():
+    with fluid.dygraph.guard():
+        fc = fluid.dygraph.Linear(4, 3, act="relu")
+        emb = fluid.dygraph.Embedding(size=[10, 4])
+        bn = fluid.dygraph.BatchNorm(3, act="relu")
+        conv = fluid.dygraph.Conv2D(1, 3, 3, act="relu")
+        pool = fluid.dygraph.Pool2D(pool_size=2, pool_stride=2)
+        x = fluid.dygraph.to_variable(
+            np.random.RandomState(0).randn(2, 4).astype("float32"))
+        out = fc(x)
+        assert out.shape == [2, 3]
+        assert float(out.numpy().min()) >= 0.0  # act=relu applied
+        ids = fluid.dygraph.to_variable(np.array([[1, 2], [3, 4]], "int64"))
+        assert emb(ids).shape == [2, 2, 4]
+        img = fluid.dygraph.to_variable(
+            np.random.RandomState(1).randn(2, 1, 8, 8).astype("float32"))
+        y = pool(bn(conv(img)))
+        assert y.shape == [2, 3, 3, 3]
+
+
+def test_fluid_dygraph_train_loop():
+    with fluid.dygraph.guard():
+        model = fluid.dygraph.Linear(8, 1)
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameter_list=model.parameters())
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 8).astype("float32")
+        w_true = rng.randn(8, 1).astype("float32")
+        y = x @ w_true
+        losses = []
+        for _ in range(10):
+            pred = model(fluid.dygraph.to_variable(x))
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(
+                    pred, fluid.dygraph.to_variable(y)))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+
+
+def test_fluid_layers_tensor_and_reduce_forms():
+    with fluid.dygraph.guard():
+        x = fluid.dygraph.to_variable(
+            np.arange(12, dtype="float32").reshape(3, 4))
+        assert float(fluid.layers.reduce_sum(x).numpy()) == 66.0
+        assert fluid.layers.reduce_mean(x, dim=1).shape == [3]
+        assert fluid.layers.reduce_max(x, dim=0, keep_dim=True).shape == [1, 4]
+        s = fluid.layers.concat([x, x], axis=0)
+        assert s.shape == [6, 4]
+        f = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+        np.testing.assert_allclose(f.numpy(), np.full((2, 2), 3.0))
+        e = fluid.layers.elementwise_add(x, x, act="relu")
+        np.testing.assert_allclose(e.numpy(), 2 * x.numpy())
+        assert fluid.layers.shape(x).numpy().tolist() == [3, 4]
+
+
+def test_fluid_lr_schedulers_return_working_schedulers():
+    sched = fluid.layers.exponential_decay(0.1, decay_steps=10,
+                                           decay_rate=0.5)
+    vals = []
+    for _ in range(21):
+        vals.append(sched())
+        sched.step()
+    assert abs(vals[0] - 0.1) < 1e-9
+    assert abs(vals[10] - 0.05) < 1e-6
+    assert abs(vals[20] - 0.025) < 1e-6
+    pw = fluid.layers.piecewise_decay([5, 10], [0.1, 0.01, 0.001])
+    for _ in range(6):
+        pw.step()
+    assert abs(pw() - 0.01) < 1e-9
+
+
+def test_fluid_metrics_accumulators():
+    m = fluid.metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=10)
+    assert abs(m.eval() - 0.75) < 1e-9
+    p = fluid.metrics.Precision()
+    p.update(np.array([1, 1, 0, 1]), np.array([1, 0, 0, 1]))
+    assert abs(p.eval() - 2 / 3) < 1e-9
+
+
+def test_fluid_ps_era_names_raise_informative():
+    with pytest.raises(NotImplementedError, match="paddle.nn.LSTM"):
+        fluid.layers.dynamic_lstm(None, 4)
+    with pytest.raises(NotImplementedError, match="DataLoader"):
+        fluid.layers.py_reader()
+    with pytest.raises(NotImplementedError):
+        fluid.optimizer.DGCMomentumOptimizer()
+
+
+def test_fluid_io_save_load_params(tmp_path):
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            out = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        fluid.io.save_params(exe, str(tmp_path), main_program=main)
+        # clobber, then restore
+        from paddle_tpu.framework.scope import global_scope
+
+        for v in main.global_block().vars.values():
+            if getattr(v, "persistable", False):
+                global_scope().set(v.name, np.zeros(v.shape, "float32"))
+        fluid.io.load_params(exe, str(tmp_path), main_program=main)
+        back, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(back))
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_set_global_initializer():
+    fluid.initializer.set_global_initializer(
+        fluid.initializer.Constant(0.5), fluid.initializer.Constant(0.1))
+    try:
+        from paddle_tpu import nn
+
+        fc = nn.Linear(3, 2)
+        np.testing.assert_allclose(fc.weight.numpy(), np.full((3, 2), 0.5))
+        np.testing.assert_allclose(fc.bias.numpy(), np.full((2,), 0.1))
+    finally:
+        fluid.initializer.set_global_initializer(None, None)
+    fc2 = __import__("paddle_tpu").nn.Linear(3, 2)
+    assert np.abs(fc2.weight.numpy() - 0.5).max() > 1e-3

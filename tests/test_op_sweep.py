@@ -951,6 +951,9 @@ EXEMPT = {
     "quantized_matmul": "int8 execution path — numpy-int8 parity + "
                         "predictor accuracy contract "
                         "(test_int8_inference.py)",
+    "quantized_conv2d": "int8 conv execution path — predictor accuracy "
+                        "contract vs fp32 (test_int8_inference."
+                        "test_int8_conv_rewrite_and_numerics)",
 }
 
 # ---------------------------------------------------------------------------
