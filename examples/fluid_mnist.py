@@ -28,7 +28,7 @@ def convolutional_neural_network(img, label):
     conv_pool_2 = fluid.nets.simple_img_conv_pool(
         input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
         pool_stride=2, act="relu")
-    prediction = fluid.layers.fc(conv_pool_2, size=10, activation="softmax")
+    prediction = fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
     loss = fluid.layers.cross_entropy(input=prediction, label=label)
     avg_loss = fluid.layers.mean(loss)
     acc = fluid.layers.accuracy(input=prediction, label=label)

@@ -259,7 +259,6 @@ class BilinearTensorProduct(Layer):
 from ... import jit  # noqa: E402
 
 declarative = jit.to_static
-TracedLayer = None
 
 
 class ProgramTranslator:

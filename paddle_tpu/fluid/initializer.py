@@ -11,8 +11,25 @@ Constant = ConstantInitializer = _init.Constant
 Uniform = UniformInitializer = _init.Uniform
 Normal = NormalInitializer = _init.Normal
 TruncatedNormal = TruncatedNormalInitializer = _init.TruncatedNormal
-Xavier = XavierInitializer = _init.XavierNormal
-MSRA = MSRAInitializer = _init.KaimingNormal
+
+
+def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0):  # noqa: N802
+    """Reference XavierInitializer: ``uniform=True`` by DEFAULT (the 2.x
+    split classes are XavierUniform/XavierNormal)."""
+    cls = _init.XavierUniform if uniform else _init.XavierNormal
+    return cls(fan_in=fan_in, fan_out=fan_out)
+
+
+def MSRA(uniform=True, fan_in=None, seed=0, negative_slope=0.0,  # noqa: N802
+         nonlinearity="relu"):
+    """Reference MSRAInitializer: ``uniform=True`` by default."""
+    cls = _init.KaimingUniform if uniform else _init.KaimingNormal
+    return cls(fan_in=fan_in, negative_slope=negative_slope,
+               nonlinearity=nonlinearity)
+
+
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
 Bilinear = BilinearInitializer = getattr(_init, "Bilinear", None)
 NumpyArrayInitializer = _init.Assign
 

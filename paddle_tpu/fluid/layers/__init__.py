@@ -26,10 +26,19 @@ from ...ops.dispatch import dispatch, single
 from ...static import nn as snn
 from ...static.input import data as _static_data
 
-# the full static.nn builder family (fc, batch_norm, embedding, conv2d,
+# the full static.nn builder family (batch_norm, embedding, conv2d,
 # sequence_*, cond/while_loop/case/switch_case, create_parameter, ...)
 from ...static.nn import *  # noqa: F401,F403
 from ...static.nn import __all__ as _snn_all
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """v2.1 keyword signature (input=/param_attr=/act=) over static.nn.fc
+    (weight_attr=/activation= in 2.x)."""
+    return snn.fc(input, size, num_flatten_dims=num_flatten_dims,
+                  weight_attr=param_attr, bias_attr=bias_attr,
+                  activation=act, name=name)
 
 # tensor-array / control-flow extras
 from ... import tensor_api as _T_arr
@@ -50,10 +59,11 @@ def _d(op, ins, attrs=None, slot="Out"):
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
          **kw):
-    """v2.1 ``fluid.layers.data``: prepends the -1 batch dim unless the
-    caller already gave one (reference fluid/layers/io.py:data)."""
-    shape = list(shape)
-    if append_batch_size and (not shape or shape[0] != -1):
+    """v2.1 ``fluid.layers.data``: prepends the -1 batch dim — unless the
+    caller already gave ANY variable (-1/None) dim, which the reference
+    treats as "shape is complete" (fluid/layers/io.py:data)."""
+    shape = [-1 if d is None else int(d) for d in shape]
+    if append_batch_size and all(d >= 0 for d in shape):
         shape = [-1] + shape
     return _static_data(name, shape, dtype=dtype, lod_level=lod_level)
 

@@ -33,7 +33,7 @@ def _fluidify(cls, **renames):
 
 SGDOptimizer = _fluidify(_opt.SGD)
 MomentumOptimizer = _fluidify(_opt.Momentum)
-AdamOptimizer = _fluidify(_opt.Adam, beta1="beta1", beta2="beta2")
+AdamOptimizer = _fluidify(_opt.Adam)
 AdamaxOptimizer = _fluidify(_opt.Adamax)
 AdagradOptimizer = _fluidify(_opt.Adagrad)
 AdadeltaOptimizer = _fluidify(_opt.Adadelta)

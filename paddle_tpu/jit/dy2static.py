@@ -443,10 +443,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         step = a[2] if len(a) == 3 else ast.Constant(value=1)
         sv, ev, tv = f"_pt_start_{k}", f"_pt_stop_{k}", f"_pt_step_{k}"
         i = node.target.id
+        # `if step == 0: raise` mirrors Python's range() contract — without
+        # it the synthesized while (i += 0 forever) would hang the trace.
+        # For a concrete Python step this fires at trace time; a Tensor-
+        # valued step hits the Tensor-__bool__ guard with its own error.
+        zero_guard = ast.parse(
+            f"if {tv} == 0:\n"
+            f"    raise ValueError('range() arg 3 must not be zero')"
+        ).body[0]
         prelude = [
             ast.Assign(targets=[_name(sv, ast.Store())], value=start),
             ast.Assign(targets=[_name(ev, ast.Store())], value=stop),
             ast.Assign(targets=[_name(tv, ast.Store())], value=step),
+            zero_guard,
             ast.Assign(targets=[_name(i, ast.Store())], value=_name(sv)),
         ]
         # step-sign-aware loop test: `i < stop if step > 0 else i > stop`

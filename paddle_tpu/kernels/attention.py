@@ -48,16 +48,32 @@ def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False,
     return jnp.einsum("...qk,...kd->...qd", probs, v)
 
 
-def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0, rng=None):
+def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
+         rng=None, layout="bnsd"):
     """Dispatch to the Pallas flash kernel on TPU when profitable, else the
-    XLA-fused reference (dropout always takes the reference path)."""
+    XLA-fused reference (dropout always takes the reference path).
+
+    ``layout="bsnd"`` ([b, s, nh, d], the model-natural layout after a QKV
+    projection) feeds the seq-major kernel specs directly — no materialized
+    transposes around the custom call (flash._fwd_call_smajor)."""
     from . import flash
     from ..framework import flags
 
+    s_axis = -3 if layout == "bsnd" else -2
     if (flags.flag("FLAGS_tpu_flash_attention")
-            and flash.available() and q.shape[-2] >= 512
-            and flash.supported(q, k, mask=mask, dropout_p=dropout_p)):
-        return flash.flash_attention(q, k, v, causal=is_causal, scale=scale)
+            and flash.available() and q.shape[s_axis] >= 512
+            and flash.supported(q, k, mask=mask, dropout_p=dropout_p,
+                                layout=layout)):
+        return flash.flash_attention(q, k, v, causal=is_causal, scale=scale,
+                                     layout=layout)
+    if layout == "bsnd":
+        # reference path works on [..., s, d]: transpose in/out (CPU tests;
+        # perf path is the kernel above)
+        qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+        out = _sdpa_reference(qt, kt, vt, mask=mask, scale=scale,
+                              is_causal=is_causal, dropout_p=dropout_p,
+                              rng=rng)
+        return jnp.swapaxes(out, 1, 2)
     return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal,
                            dropout_p=dropout_p, rng=rng)
 
@@ -74,12 +90,14 @@ def sdpa_kernel(ins, attrs, rng=None):
         scale=attrs.get("scale"),
         is_causal=attrs.get("is_causal", False),
         dropout_p=p, rng=rng,
+        layout=attrs.get("layout", "bnsd"),
     )
     return {"Out": out}
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True):
+                                 is_causal=False, training=True,
+                                 layout="bnsd"):
     from ..ops.dispatch import dispatch, single
 
     ins = {"Q": [query], "K": [key], "V": [value]}
@@ -89,6 +107,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         dispatch(
             "scaled_dot_product_attention",
             ins,
-            {"dropout_p": dropout_p, "is_causal": is_causal, "is_test": not training},
+            {"dropout_p": dropout_p, "is_causal": is_causal,
+             "is_test": not training, "layout": layout},
         )
     )

@@ -94,6 +94,11 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv(x)
         local_h = qkv.shape[-1] // 3
         nh = local_h // self.head_dim
+        # measured (flagship, v5e): the [b,nh,s,hd] transposes around the
+        # flash call cost ~34ms/step, but the seq-major kernel variant
+        # (layout="bsnd", kernels/flash._fwd_call_smajor) loses MORE to
+        # strided K/V DMA (55.0% vs 57.1% MFU) — contiguous (bh, s, d)
+        # tiles + XLA transposes win, so this stays bnsd
         qkv = T.reshape(qkv, [b, s, 3, nh, self.head_dim])
         qkv = T.transpose(qkv, [2, 0, 3, 1, 4])  # [3, b, nh, s, hd]
         q, k, v = qkv[0], qkv[1], qkv[2]

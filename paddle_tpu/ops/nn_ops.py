@@ -373,6 +373,12 @@ def layer_norm_kernel(ins, attrs):
     bna = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(bna, x.ndim))
     xf = x.astype(jnp.float32)
+    # NOTE: keep jnp.var's centered two-pass form.  The E[x^2]-E[x]^2
+    # one-pass rewrite (a win for batch_norm's big feature maps) measured
+    # 2.6 MFU points WORSE on the GPT flagship: XLA fuses THIS pattern's
+    # normalize into the following projection GEMM (the profile shows
+    # convolution fusions consuming mean/rstd directly), and the rewrite
+    # broke that fusion (A/B on v5e: 22,655 vs 21,633 tok/s).
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.var(xf, axis=axes, keepdims=True)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)

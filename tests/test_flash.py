@@ -126,3 +126,35 @@ def test_sdpa_dispatch_falls_back_on_unsupported_shape(monkeypatch):
     ref = _sdpa_reference(jnp.asarray(qn), jnp.asarray(qn), jnp.asarray(qn),
                           is_causal=True)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bsnd_seq_major_matches_bnsd():
+    """Seq-major specs (no transposes around the kernel) == the bnsd path,
+    forward AND gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import flash
+
+    rng = np.random.RandomState(0)
+    b, s, nh, d = 2, 128, 3, 32
+    q = jnp.asarray(rng.randn(b, s, nh, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, s, nh, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, s, nh, d).astype("float32"))
+
+    def f_bsnd(q, k, v):
+        return jnp.sum(flash.flash_attention(
+            q, k, v, causal=True, layout="bsnd", interpret=True) ** 2)
+
+    def f_bnsd(q, k, v):
+        qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+        out = flash.flash_attention(qt, kt, vt, causal=True, interpret=True)
+        return jnp.sum(jnp.swapaxes(out, 1, 2) ** 2)
+
+    np.testing.assert_allclose(np.asarray(f_bsnd(q, k, v)),
+                               np.asarray(f_bnsd(q, k, v)), rtol=2e-5)
+    g1 = jax.grad(f_bsnd, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_bnsd, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)

@@ -32,7 +32,7 @@ def test_fluid_static_mnist_slice_trains():
             conv = fluid.nets.simple_img_conv_pool(
                 img, filter_size=3, num_filters=4, pool_size=2,
                 pool_stride=2, act="relu")
-            pred = fluid.layers.fc(conv, size=4, activation="softmax")
+            pred = fluid.layers.fc(conv, size=4, act="softmax")
             loss = fluid.layers.mean(
                 fluid.layers.cross_entropy(input=pred, label=label))
             acc = fluid.layers.accuracy(input=pred, label=label)
@@ -200,3 +200,51 @@ def test_fluid_set_global_initializer():
         fluid.initializer.set_global_initializer(None, None)
     fc2 = __import__("paddle_tpu").nn.Linear(3, 2)
     assert np.abs(fc2.weight.numpy() - 0.5).max() > 1e-3
+
+
+def test_fluid_fc_v21_keyword_signature():
+    paddle.enable_static()
+    try:
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data("x", shape=[4])
+            out = fluid.layers.fc(input=x, size=3, act="softmax",
+                                  param_attr=fluid.ParamAttr(name="fcw"))
+            assert out.shape[-1] == 3
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_data_variable_dims_skip_batch_prepend():
+    paddle.enable_static()
+    try:
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            v = fluid.layers.data("s", shape=[3, -1])
+            assert list(v.shape) == [3, -1]
+            w = fluid.layers.data("t", shape=[None, 5])
+            assert list(w.shape) == [-1, 5]
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_xavier_msra_uniform_default():
+    from paddle_tpu.nn import initializer as init2
+
+    assert isinstance(fluid.initializer.Xavier(), init2.XavierUniform)
+    assert isinstance(fluid.initializer.Xavier(uniform=False),
+                      init2.XavierNormal)
+    assert isinstance(fluid.initializer.MSRA(), init2.KaimingUniform)
+    assert isinstance(fluid.initializer.MSRA(uniform=False),
+                      init2.KaimingNormal)
+
+
+def test_dy2static_zero_step_range_raises():
+    from paddle_tpu.jit import dy2static
+
+    def f(x):
+        for i in range(5, 0, 0):
+            x = x + 1.0
+        return x
+
+    conv = dy2static.convert_func(f)
+    with pytest.raises(ValueError, match="must not be zero"):
+        conv(paddle.to_tensor(np.asarray(1.0, "float32")))
