@@ -13,19 +13,29 @@ Normal = NormalInitializer = _init.Normal
 TruncatedNormal = TruncatedNormalInitializer = _init.TruncatedNormal
 
 
-def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0):  # noqa: N802
+class Xavier(_init.Initializer):
     """Reference XavierInitializer: ``uniform=True`` by DEFAULT (the 2.x
-    split classes are XavierUniform/XavierNormal)."""
-    cls = _init.XavierUniform if uniform else _init.XavierNormal
-    return cls(fan_in=fan_in, fan_out=fan_out)
+    split classes are XavierUniform/XavierNormal).  A class (not a
+    factory) so isinstance/subclass checks on the compat name keep
+    working; __new__ returns the matching 2.x variant."""
+
+    def __new__(cls, uniform=True, fan_in=None, fan_out=None, seed=0):
+        if cls is not Xavier:
+            return super().__new__(cls)
+        impl = _init.XavierUniform if uniform else _init.XavierNormal
+        return impl(fan_in=fan_in, fan_out=fan_out)
 
 
-def MSRA(uniform=True, fan_in=None, seed=0, negative_slope=0.0,  # noqa: N802
-         nonlinearity="relu"):
+class MSRA(_init.Initializer):
     """Reference MSRAInitializer: ``uniform=True`` by default."""
-    cls = _init.KaimingUniform if uniform else _init.KaimingNormal
-    return cls(fan_in=fan_in, negative_slope=negative_slope,
-               nonlinearity=nonlinearity)
+
+    def __new__(cls, uniform=True, fan_in=None, seed=0, negative_slope=0.0,
+                nonlinearity="relu"):
+        if cls is not MSRA:
+            return super().__new__(cls)
+        impl = _init.KaimingUniform if uniform else _init.KaimingNormal
+        return impl(fan_in=fan_in, negative_slope=negative_slope,
+                    nonlinearity=nonlinearity)
 
 
 XavierInitializer = Xavier

@@ -67,6 +67,9 @@ def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
         return flash.flash_attention(q, k, v, causal=is_causal, scale=scale,
                                      layout=layout)
     if layout == "bsnd":
+        if q.ndim != 4:
+            raise ValueError(
+                f"layout='bsnd' expects [b, s, nh, d] (4-D), got {q.shape}")
         # reference path works on [..., s, d]: transpose in/out (CPU tests;
         # perf path is the kernel above)
         qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
