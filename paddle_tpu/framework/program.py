@@ -60,6 +60,17 @@ class Variable:
     a symbolic handle; its value lives in a Scope at run time (jax.Array).
     """
 
+    def __bool__(self):
+        # Parity: the reference raises here too (math_op_patch) — without
+        # this, `if some_var:` / `while some_var:` in UNCONVERTED static
+        # code silently takes the true branch (object default truthiness)
+        # or spins forever, instead of failing at the broken line.
+        raise TypeError(
+            f"bool(Variable '{self.name}') is undefined in a static graph: "
+            "a Variable has no value at trace time.  Use "
+            "paddle.static.nn.cond / while_loop, or run the function "
+            "through paddle.jit.to_static so `if`/`while` convert.")
+
     def __init__(
         self,
         block: "Block",

@@ -137,17 +137,44 @@ def convert_while(cond_fn: Callable, body_fn: Callable, vals):
     probe = cond_fn(*vals)
     if not _is_symbolic(probe):
         del block.ops[start:]  # no ops should exist, but be safe
-        while _truth(probe):
+        while True:
+            if _is_symbolic(probe):
+                # the condition TURNED symbolic mid-unroll (e.g. `while
+                # True` whose break flag became a Variable): the python-
+                # unrolled iterations so far are a valid trace prefix —
+                # lower the REST as an in-graph while_loop from the
+                # current values instead of spinning forever
+                return _symbolic_while(cond_fn, body_fn, vals)
+            if not _truth(probe):
+                break
             vals = list(body_fn(*vals))
             probe = cond_fn(*vals)
         return tuple(vals)
     del block.ops[start:]  # drop probe ops; while_loop re-captures
+    return _symbolic_while(cond_fn, body_fn, vals)
 
+
+def _symbolic_while(cond_fn, body_fn, vals):
     from ..static.control_flow import while_loop
 
     sym_vals = [_promote(v) for v in vals]
-    outs = while_loop(lambda *a: cond_fn(*a), lambda *a: list(body_fn(*a)),
-                      sym_vals)
+
+    global _sym_loop_depth
+
+    def _cond(*a):
+        return cond_fn(*a)
+
+    def _body(*a):
+        global _sym_loop_depth
+        _sym_loop_depth += 1
+        try:
+            # promote Python values the body re-binds (e.g. the break/
+            # continue flag resets) — every carried value must be a Variable
+            return [_promote(v) for v in body_fn(*a)]
+        finally:
+            _sym_loop_depth -= 1
+
+    outs = while_loop(_cond, _body, sym_vals)
     return tuple(outs)
 
 
@@ -155,6 +182,66 @@ def _truth(v):
     if hasattr(v, "_array"):
         return bool(np.asarray(v._array).reshape(-1)[0])
     return bool(v)
+
+
+def loop_test(test, brk):
+    """Combined loop condition ``test and not brk`` that works for Python
+    bools AND symbolic Variables (the break/continue transform's loop
+    gate — reference break_continue_transformer role)."""
+    if _is_symbolic(test) or _is_symbolic(brk):
+        from .. import tensor_api as T
+
+        t = test if _is_symbolic(test) else _promote(bool(_truth(test)))
+        b = brk if _is_symbolic(brk) else _promote(bool(_truth(brk)))
+        return T.logical_and(T.cast(t, "bool"),
+                             T.logical_not(T.cast(b, "bool")))
+    return _truth(test) and not _truth(brk)
+
+
+def flags_clear(*flags):
+    """True while none of the break/continue flags is set; symbolic when
+    any flag is a Variable (guards the statements after a conditional
+    break/continue)."""
+    if any(_is_symbolic(f) for f in flags):
+        from .. import tensor_api as T
+
+        acc = None
+        for f in flags:
+            fv = f if _is_symbolic(f) else _promote(bool(_truth(f)))
+            fv = T.cast(fv, "bool")
+            acc = fv if acc is None else T.logical_or(acc, fv)
+        return T.logical_not(acc)
+    return not any(_truth(f) for f in flags)
+
+
+# list op conversion (reference list_transformer / convert_operators role):
+# python lists keep python semantics everywhere EXCEPT inside a symbolic
+# (in-graph) while, where an append would silently run once at trace time —
+# that case raises with the supported alternative.
+_sym_loop_depth = 0
+
+
+def convert_append(obj, x):
+    if isinstance(obj, list):
+        if _sym_loop_depth > 0:
+            # ANY append inside an in-graph loop body runs exactly once at
+            # trace time — silently wrong regardless of the payload type
+            raise ConversionError(
+                "list.append inside a TENSOR-bounded loop cannot grow a "
+                "Python list in-graph; preallocate with paddle.zeros and "
+                "write slices, or keep the loop bound a Python int "
+                "(trace-time unrolling)")
+        obj.append(x)
+        return None
+    return obj.append(x)
+
+
+def convert_pop(obj, *args):
+    if isinstance(obj, list) and _sym_loop_depth > 0:
+        raise ConversionError(
+            "list.pop inside a TENSOR-bounded loop is not convertible; "
+            "keep the loop bound a Python int (trace-time unrolling)")
+    return obj.pop(*args)
 
 
 # ---------------------------------------------------------------------------
@@ -318,6 +405,99 @@ class _Ctr:
         return self.n
 
 
+def _stmt_sets_flag(st, brk, cont) -> bool:
+    """Does this (already rewritten) statement possibly set a loop flag?
+    (Nested loops own their breaks and are not descended into.)"""
+    for node in ast.walk(st):
+        if isinstance(node, (ast.While, ast.For, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) \
+                and node.id in (brk, cont):
+            return True
+    return False
+
+
+def _rewrite_break_continue(body, brk: str, cont: str):
+    """Replace this loop's ``break``/``continue`` with flag assignments and
+    guard every statement after a possible flag-set with
+    ``if flags_clear(brk, cont):`` (the reference's
+    break_continue_transformer strategy).  Nested loops keep their own
+    break/continue untouched."""
+
+    def set_flag(name, node):
+        return ast.copy_location(
+            ast.Assign(targets=[_name(name, ast.Store())],
+                       value=ast.Constant(value=True)), node)
+
+    def guard(stmts):
+        out: List[ast.stmt] = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(set_flag(brk, st))
+                touched = True
+            elif isinstance(st, ast.Continue):
+                out.append(set_flag(cont, st))
+                touched = True
+            elif isinstance(st, ast.If):
+                st.body = guard(st.body)
+                st.orelse = guard(st.orelse) if st.orelse else []
+                out.append(st)
+                touched = _stmt_sets_flag(st, brk, cont)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                st.body = guard(st.body)
+                out.append(st)
+                touched = _stmt_sets_flag(st, brk, cont)
+            elif isinstance(st, ast.Try):
+                st.body = guard(st.body)
+                st.orelse = guard(st.orelse) if st.orelse else []
+                st.finalbody = guard(st.finalbody) if st.finalbody else []
+                for h in st.handlers:
+                    h.body = guard(h.body)
+                out.append(st)
+                touched = _stmt_sets_flag(st, brk, cont)
+            else:
+                out.append(st)  # nested loops keep their own break/continue
+                touched = False
+            rest = stmts[i + 1:]
+            if touched and rest:
+                g = ast.If(
+                    test=ast.Call(func=_helper("flags_clear"),
+                                  args=[_name(brk), _name(cont)],
+                                  keywords=[]),
+                    body=guard(rest), orelse=[])
+                out.append(ast.copy_location(g, st))
+                return out
+        return out
+
+    return guard(body)
+
+
+def _loop_has_break(body) -> bool:
+    """Break/Continue belonging to THIS loop (not a nested one)."""
+
+    class F(ast.NodeVisitor):
+        found = False
+
+        def generic_visit(self, node):
+            if self.found:
+                return
+            if isinstance(node, (ast.Break, ast.Continue)):
+                self.found = True
+                return
+            if isinstance(node, (ast.While, ast.For, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                return
+            super().generic_visit(node)
+
+    f = F()
+    for s in body:
+        f.generic_visit(s)
+        if f.found:
+            return True
+    return False
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     """Rewrite if/while/for statements into runtime-dispatched helpers."""
 
@@ -385,18 +565,51 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         assign = ast.Assign(targets=[target], value=call)
         return [ast.copy_location(s, node) for s in (tdef, fdef, assign)]
 
+    def _flagged_loop(self, node, k, extra_tail=None):
+        """break/continue machinery shared by while and for-range: returns
+        (prelude_stmts, test_expr, body_stmts).  ``extra_tail`` (the
+        for-range index bump) runs every iteration a break did not end —
+        including ones a `continue` cut short."""
+        ret = _contains(node.body, ast.Return)
+        if ret is not None:
+            raise self._err(
+                ret, "'return' inside a convertible loop is not convertible "
+                     "— assign to a variable and return after the loop")
+        body = list(node.body)
+        test = node.test if isinstance(node, ast.While) else None
+        prelude: List[ast.stmt] = []
+        if _loop_has_break(body):
+            brk, cont = f"_brk{k}", f"_cont{k}"
+            # BOTH flags init in the prelude: the loop capture reads every
+            # carried name before the first iteration runs
+            for fname in (brk, cont):
+                prelude.append(ast.Assign(
+                    targets=[_name(fname, ast.Store())],
+                    value=ast.Constant(value=False)))
+            body = ([ast.Assign(targets=[_name(cont, ast.Store())],
+                                value=ast.Constant(value=False))]
+                    + _rewrite_break_continue(body, brk, cont))
+            if extra_tail:
+                # the bump must run unless the loop BROKE (a continue
+                # still advances the index — Python for semantics)
+                body = body + [ast.If(
+                    test=ast.Call(func=_helper("flags_clear"),
+                                  args=[_name(brk)], keywords=[]),
+                    body=list(extra_tail), orelse=[])]
+            test = ast.Call(func=_helper("loop_test"),
+                            args=[test, _name(brk)], keywords=[])
+        elif extra_tail:
+            body = body + list(extra_tail)
+        return prelude, test, body
+
     # -- while ----------------------------------------------------------
     def visit_While(self, node: ast.While):
-        self.generic_visit(node)
-        bad = _contains(node.body, (ast.Break, ast.Continue, ast.Return))
-        if bad is not None:
-            kind = type(bad).__name__.lower()
-            raise self._err(
-                bad, f"'{kind}' inside a convertible 'while' loop is not "
-                     f"convertible — restructure with a loop condition/flag")
         if node.orelse:
             raise self._err(node, "while/else is not convertible")
         k = self.ctr.next()
+        prelude, test, body = self._flagged_loop(node, k)
+        node.test, node.body = test, body
+        self.generic_visit(node)
         cname, bname = f"_pt_cond_{k}", f"_pt_body_{k}"
         loop_vars = sorted(set(_assigned_names(node.body)))
         if not loop_vars:
@@ -421,7 +634,22 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 elts=[_name(n, ast.Store()) for n in loop_vars],
                 ctx=ast.Store())
         assign = ast.Assign(targets=[target], value=call)
-        return [ast.copy_location(s, node) for s in (cdef, bdef, assign)]
+        return [ast.copy_location(s, node)
+                for s in (prelude + [cdef, bdef, assign])]
+
+    # -- list ops (reference list_transformer role) ----------------------
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Attribute) and not node.keywords
+                and ((node.func.attr == "append" and len(node.args) == 1)
+                     or (node.func.attr == "pop" and len(node.args) <= 1))):
+            helper = ("convert_append" if node.func.attr == "append"
+                      else "convert_pop")
+            return ast.copy_location(ast.Call(
+                func=_helper(helper),
+                args=[node.func.value] + list(node.args),
+                keywords=[]), node)
+        return node
 
     # -- for i in range(...) --------------------------------------------
     def visit_For(self, node: ast.For):
@@ -474,8 +702,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         bump = ast.Assign(
             targets=[_name(i, ast.Store())],
             value=ast.BinOp(left=_name(i), op=ast.Add(), right=_name(tv)))
-        wh = ast.While(test=test, body=node.body + [bump], orelse=[])
-        out = [ast.copy_location(s, node) for s in prelude + [wh]]
+        # break/continue machinery BEFORE the while conversion; the bump is
+        # the extra_tail so `continue` still advances the index
+        tmp = ast.While(test=test, body=node.body, orelse=[])
+        flag_prelude, test2, body2 = self._flagged_loop(tmp, k,
+                                                        extra_tail=[bump])
+        wh = ast.While(test=test2, body=body2, orelse=[])
+        out = [ast.copy_location(s, node)
+               for s in prelude + flag_prelude + [wh]]
         # now convert the while we just built
         res: List[ast.stmt] = []
         for s in out:

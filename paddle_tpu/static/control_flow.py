@@ -303,6 +303,14 @@ def cond(pred, true_fn: Optional[Callable] = None,
     t_names = [v.name for v in true_outs]
     f_names = [v.name for v in false_outs]
     ext_names = _externals([true_ops, false_ops], set())
+    # identity pass-throughs: a branch may RETURN a pre-existing var without
+    # creating any op (e.g. the untaken side of a converted break-flag if);
+    # those names must ride in as captured externals too
+    for names, ops in ((t_names, true_ops), (f_names, false_ops)):
+        produced = {n for op in ops for n in op.output_arg_names}
+        for n in names:
+            if n not in produced and n not in ext_names:
+                ext_names.append(n)
     ext_vars = [block._var_recursive(n) for n in ext_names]
     single = len(true_outs) == 1
 

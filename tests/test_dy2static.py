@@ -154,16 +154,19 @@ def test_nested_if_inside_while():
     assert out[0] == 4.0
 
 
-def test_break_raises_conversion_error():
+def test_return_inside_loop_raises_conversion_error():
+    """break/continue convert now (flag machinery); `return` inside a
+    convertible loop is the remaining unsupported exit."""
+
     def fn(x):
         s = paddle.zeros([1])
         while s.sum() < 5.0:
             s = s + 1.0
-            if False:
-                break
+            if s.sum() > 2.0:
+                return s
         return s
 
-    with pytest.raises(dy2static.ConversionError, match="break"):
+    with pytest.raises(dy2static.ConversionError, match="return"):
         dy2static.convert_func(fn)
 
 
@@ -271,3 +274,193 @@ def test_sublayer_forward_converts_transitively():
         eager = np.asarray(m.inner(m.fc(paddle.to_tensor(x))).numpy())
         static = np.asarray(ms(paddle.to_tensor(x)).numpy())
         np.testing.assert_allclose(static, eager, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# break/continue (reference break_continue_transformer parity — r4 item 6)
+# ---------------------------------------------------------------------------
+
+
+def test_break_in_for_range_python_bound():
+    def fn(x):
+        acc = paddle.zeros([1])
+        for i in range(100):
+            if i == 3:
+                break
+            acc = acc + x
+        return acc
+
+    out = _run_both(fn, np.full((1,), 2.0, "float32"))
+    assert out[0] == 6.0
+
+
+def test_break_in_for_range_tensor_bound():
+    """Break on a TENSOR predicate inside a TENSOR-bounded loop — the flag
+    is carried through the in-graph while_loop."""
+
+    def fn(x):
+        n = x.sum().astype("int64")  # 100
+        acc = paddle.zeros([1])
+        for i in range(n):
+            if acc.sum() >= 5.0:
+                break
+            acc = acc + 1.0
+        return acc
+
+    out = _run_both(fn, np.full((100,), 1.0, "float32"))
+    assert out[0] == 5.0
+
+
+def test_continue_in_for_range_tensor_bound():
+    def fn(x):
+        n = x.sum().astype("int64")  # 6
+        acc = paddle.zeros([1])
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            acc = acc + 1.0
+        return acc
+
+    out = _run_both(fn, np.full((6,), 1.0, "float32"))
+    assert out[0] == 3.0  # i = 1, 3, 5
+
+
+def test_break_statements_after_guard():
+    def fn(x):
+        acc = paddle.zeros([1])
+        for i in range(10):
+            if i == 4:
+                break
+            acc = acc + x
+            acc = acc + x
+        return acc
+
+    out = _run_both(fn, np.full((1,), 1.0, "float32"))
+    assert out[0] == 8.0
+
+
+def test_break_in_while_tensor_condition():
+    def fn(x):
+        acc = paddle.zeros([1])
+        while acc.sum() < 100.0:
+            acc = acc + x
+            if acc.sum() >= 7.0:
+                break
+        return acc
+
+    out = _run_both(fn, np.full((1,), 2.0, "float32"))
+    assert out[0] == 8.0
+
+
+def test_nested_loop_break_stays_inner():
+    def fn(x):
+        acc = paddle.zeros([1])
+        for i in range(3):
+            for j in range(10):
+                if j >= 2:
+                    break
+                acc = acc + x
+        return acc
+
+    out = _run_both(fn, np.full((1,), 1.0, "float32"))
+    assert out[0] == 6.0
+
+
+def test_return_chain_normalization():
+    def fn(x):
+        if x.sum() > 10.0:
+            return x * 2.0
+        if x.sum() > 5.0:
+            return x * 3.0
+        return x
+
+    _run_both(fn, np.full((3,), 4.0, "float32"))   # 12 -> first branch
+    _run_both(fn, np.full((3,), 2.0, "float32"))   # 6  -> second branch
+    _run_both(fn, np.full((3,), 1.0, "float32"))   # 3  -> fallthrough
+
+
+def test_list_append_trace_time_loop():
+    def fn(x):
+        acc = []
+        for i in range(4):
+            acc.append(x * float(i))
+        out = acc[0]
+        for a in acc[1:]:
+            out = out + a
+        return out
+
+    out = _run_both(fn, np.full((1,), 2.0, "float32"))
+    assert out[0] == 12.0
+
+
+def test_list_append_symbolic_loop_raises():
+    """Appending Tensors to a Python list inside a TENSOR-bounded loop
+    would silently run once at trace time — must raise with guidance."""
+
+    def fn(x):
+        n = x.sum().astype("int64")
+        acc = []
+        i = paddle.zeros([1])
+        while i.sum() < n:
+            acc.append(i * 1.0)
+            i = i + 1
+        return i
+
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            xv = static.data("x", [4], "float32")
+            conv = dy2static.convert_func(fn)
+            with pytest.raises(dy2static.ConversionError,
+                               match="preallocate|trace-time"):
+                conv(xv)
+    finally:
+        paddle.disable_static()
+
+
+def test_while_true_tensor_break_static():
+    """`while True` + tensor-predicated break: the condition turns symbolic
+    mid-unroll and the loop must lower to an in-graph while from there."""
+
+    def fn(x):
+        acc = paddle.zeros([1])
+        while True:
+            acc = acc + x
+            if acc.sum() >= 5.0:
+                break
+        return acc
+
+    out = _run_both(fn, np.full((1,), 2.0, "float32"))
+    assert out[0] == 6.0
+
+
+def test_break_inside_with_block():
+    import contextlib
+
+    def fn(x):
+        total = x * 0.0
+        for i in range(10):
+            with contextlib.nullcontext():
+                if i == 2:
+                    break
+            total = total + x
+        return total
+
+    out = _run_both(fn, np.full((1,), 1.0, "float32"))
+    assert out[0] == 2.0
+
+
+def test_variable_bool_raises_in_static():
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+
+        with static.program_guard(static.Program(), static.Program()):
+            v = static.data("b", [1], "float32")
+            with pytest.raises(TypeError, match="cond"):
+                bool(v)
+    finally:
+        paddle.disable_static()
