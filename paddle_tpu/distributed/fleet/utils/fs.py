@@ -162,14 +162,117 @@ class LocalFS(FS):
 
 
 class HDFSClient(FS):
-    """Parity surface: fs.py:423 — requires a hadoop CLI, absent here."""
+    """Real shell-out client over ``hadoop fs`` (fs.py:423 parity).
+
+    When a hadoop CLI exists at ``hadoop_home/bin/hadoop`` every operation
+    runs ``hadoop fs -<cmd>`` with the given ``configs`` as ``-D`` options
+    (the reference shells out the same way); without one, construction
+    raises with the supported deployment route instead of failing later
+    on the first operation."""
 
     def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
                  sleep_inter=1000):
         self._hadoop = os.path.join(hadoop_home or "", "bin", "hadoop")
+        self._timeout = max(time_out / 1000.0, 1.0)
+        self._configs = []
+        for k, v in (configs or {}).items():
+            self._configs += ["-D", f"{k}={v}"]
         if not os.path.exists(self._hadoop):
             raise RuntimeError(
                 "HDFSClient needs a hadoop CLI (hadoop_home/bin/hadoop); "
                 "none found in this build — use LocalFS over a shared "
                 "mount (GCS-fuse/NFS), which is the TPU-pod deployment "
                 "path")
+
+    def _run(self, *args, ok_codes=(0,)):
+        import subprocess
+
+        cmd = [self._hadoop, "fs"] + self._configs + list(args)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._timeout)
+        except subprocess.TimeoutExpired as e:
+            raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
+        if proc.returncode not in ok_codes:
+            raise ExecuteError(
+                f"{' '.join(cmd)} failed (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[:500]}")
+        return proc.returncode, proc.stdout
+
+    def ls_dir(self, fs_path):
+        """(dirs, files) under fs_path — parses ``hadoop fs -ls`` rows."""
+        if not self.is_exist(fs_path):
+            return [], []
+        _, out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8 or parts[0] == "Found":
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def _test(self, flag, fs_path) -> bool:
+        rc, _ = self._run("-test", flag, fs_path, ok_codes=(0, 1))
+        return rc == 0
+
+    def is_file(self, fs_path):
+        return self._test("-f", fs_path)
+
+    def is_dir(self, fs_path):
+        return self._test("-d", fs_path)
+
+    def is_exist(self, fs_path):
+        return self._test("-e", fs_path)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        if not os.path.exists(local_path):
+            raise FSFileNotExistsError(local_path)
+        if self.is_exist(fs_path):
+            if not overwrite:
+                raise FSFileExistsError(fs_path)
+            self.delete(fs_path)
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        if not self.is_exist(fs_path):
+            raise FSFileNotExistsError(fs_path)
+        if os.path.exists(local_path) and overwrite:
+            if os.path.isdir(local_path):
+                shutil.rmtree(local_path)
+            else:
+                os.remove(local_path)
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        if self.is_exist(fs_path):
+            self._run("-rm", "-r", "-f", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path):
+        if not self.is_exist(fs_path):
+            return ""
+        _, out = self._run("-cat", fs_path)
+        return out
+
+    def need_upload_download(self):
+        return True

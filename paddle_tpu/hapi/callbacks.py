@@ -235,8 +235,60 @@ class ReduceLROnPlateau(Callback):
 
 
 class VisualDL(Callback):
-    """Stub (VisualDL itself is not available in this build)."""
+    """Training visualization callback (reference hapi/callbacks.py
+    VisualDL).  VisualDL itself is not in this build; scalars are written
+    as REAL TensorBoard event files (utils/tensorboard.py hand-encodes
+    the wire format), so ``tensorboard --logdir <log_dir>`` — or VisualDL
+    pointed at the same dir — renders the curves."""
 
     def __init__(self, log_dir="./log"):
         super().__init__()
         self.log_dir = log_dir
+        self._train_writer = None
+        self._eval_writer = None
+        self._global_step = 0
+
+    def _writer(self, mode):
+        from ..utils.tensorboard import SummaryWriter
+
+        attr = f"_{mode}_writer"
+        if getattr(self, attr) is None:
+            import os
+
+            setattr(self, attr, SummaryWriter(
+                os.path.join(self.log_dir, mode)))
+        return getattr(self, attr)
+
+    def _log(self, mode, step, logs):
+        w = self._writer(mode)
+        import numpy as np
+
+        for k, v in (logs or {}).items():
+            if k in ("batch_size", "num_samples"):
+                continue
+            try:
+                arr = np.asarray(
+                    v.numpy() if hasattr(v, "numpy") else v, dtype="float64")
+            except (TypeError, ValueError):
+                continue
+            if arr.size == 1:
+                w.add_scalar(f"{mode}/{k}", float(arr.reshape(())), step)
+            else:
+                for i, x in enumerate(arr.reshape(-1)):
+                    w.add_scalar(f"{mode}/{k}_{i}", float(x), step)
+        w.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        self._log("train", self._global_step, logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", self._global_step, logs)
+
+    def on_train_end(self, logs=None):
+        for w in (self._train_writer, self._eval_writer):
+            if w is not None:
+                w.close()
+        # a later fit/evaluate with this callback must get fresh writers
+        self._train_writer = None
+        self._eval_writer = None

@@ -135,6 +135,11 @@ class _StaticAdapter:
                 self._eval_prog = main.clone(for_test=True)
                 if m._optimizer is not None:
                     m._optimizer.minimize(loss)
+            elif label_vars:
+                # metrics-without-loss: the label vars were created AFTER
+                # the predict clone, so eval must clone NOW or its label
+                # feeds name vars the program does not have (r4 advisor)
+                self._eval_prog = main.clone(for_test=True)
             else:
                 self._eval_prog = self._predict_prog
             self._train_prog = main

@@ -142,12 +142,14 @@ def convert_while(cond_fn: Callable, body_fn: Callable, vals):
                 # the condition TURNED symbolic mid-unroll (e.g. `while
                 # True` whose break flag became a Variable): the python-
                 # unrolled iterations so far are a valid trace prefix —
-                # lower the REST as an in-graph while_loop from the
-                # current values instead of spinning forever
+                # drop this probe's ops (while_loop re-captures the
+                # condition) and lower the REST as an in-graph while_loop
+                del block.ops[start:]
                 return _symbolic_while(cond_fn, body_fn, vals)
             if not _truth(probe):
                 break
             vals = list(body_fn(*vals))
+            start = len(block.ops)  # ops up to here are the live prefix
             probe = cond_fn(*vals)
         return tuple(vals)
     del block.ops[start:]  # drop probe ops; while_loop re-captures

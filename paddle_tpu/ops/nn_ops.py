@@ -823,15 +823,45 @@ def pool3d_kernel(ins, attrs):
     return {"Out": s / cnt}
 
 
+def _data_norm_grad_maker(op, no_grad_set):
+    """The accumulator update lives in the GRAD op (data_norm_op.h does the
+    same), so programs WITHOUT backward — inference programs and
+    clone(for_test=True) eval programs — never drift the statistics
+    (round-4 advisor finding).  NOTE: the whole-block executor runs every
+    op regardless of fetch_list (reference Executor semantics with
+    use_prune=False), so evaluation over a program that ALSO contains the
+    grad ops must go through the for_test clone."""
+    inputs = {
+        "X": op.input("X"),
+        "BatchSize": op.input("BatchSize"),
+        "BatchSum": op.input("BatchSum"),
+        "BatchSquareSum": op.input("BatchSquareSum"),
+        "Y" + GRAD_SUFFIX: [op.output("Y")[0] + GRAD_SUFFIX],
+    }
+    outputs = {
+        # rebind the SAME persistent stat vars (MeanOut/VarianceOut pattern)
+        "BatchSizeOut": op.input("BatchSize"),
+        "BatchSumOut": op.input("BatchSum"),
+        "BatchSquareSumOut": op.input("BatchSquareSum"),
+    }
+    xs = [n for n in op.input("X") if n not in no_grad_set]
+    if xs:
+        outputs["X" + GRAD_SUFFIX] = [n + GRAD_SUFFIX for n in xs]
+    return [{"type": "data_norm_grad", "inputs": inputs, "outputs": outputs,
+             "attrs": dict(op.attrs)}]
+
+
 @register_op("data_norm", nondiff_slots=("BatchSize", "BatchSum",
                                          "BatchSquareSum"),
+             nondiff_out_slots=("BatchSizeOut", "BatchSumOut",
+                                "BatchSquareSumOut"),
+             grad_maker=_data_norm_grad_maker,
              list_slots=())
 def data_norm_kernel(ins, attrs):
     """Parity: data_norm_op.h — y = (x - sum/size) * sqrt(size/square_sum).
-    In training the accumulators decay + absorb the current batch (the
-    reference does this in its grad op; here it rides the forward):
-    size' = decay*size + B, sum' = decay*sum + sum(x), sq' = decay*sq +
-    sum((x - mean)^2)."""
+    The forward only NORMALIZES; the accumulator decay+absorb update runs
+    in the grad op like the reference, so evaluation passes over a
+    training-form program never move the statistics."""
     x = ins["X"]
     size = jax.lax.stop_gradient(ins["BatchSize"])
     ssum = jax.lax.stop_gradient(ins["BatchSum"])
@@ -839,17 +869,31 @@ def data_norm_kernel(ins, attrs):
     mean = ssum / size
     scale = jnp.sqrt(size / ssq)
     y = (x - mean) * scale
-    if attrs.get("is_test", False):
-        return {"Y": y, "BatchSizeOut": size, "BatchSumOut": ssum,
-                "BatchSquareSumOut": ssq}
-    decay = attrs.get("summary_decay_rate", 0.9999999)
-    b = x.shape[0]
-    xs = jax.lax.stop_gradient(x)
-    size_out = decay * size + b
-    sum_out = decay * ssum + jnp.sum(xs, axis=0)
-    sq_out = decay * ssq + jnp.sum(jnp.square(xs - mean), axis=0)
-    return {"Y": y, "BatchSizeOut": size_out, "BatchSumOut": sum_out,
-            "BatchSquareSumOut": sq_out}
+    return {"Y": y, "BatchSizeOut": size, "BatchSumOut": ssum,
+            "BatchSquareSumOut": ssq}
+
+
+@register_op("data_norm_grad", no_grad=True)
+def data_norm_grad_kernel(ins, attrs):
+    """dX = dY * scale, plus the accumulator update (training steps only):
+    size' = decay*size + B, sum' = decay*sum + sum(x), sq' = decay*sq +
+    sum((x - mean)^2)."""
+    x = ins["X"]
+    dy = ins["Y" + GRAD_SUFFIX]
+    size, ssum, ssq = ins["BatchSize"], ins["BatchSum"], ins["BatchSquareSum"]
+    mean = ssum / size
+    scale = jnp.sqrt(size / ssq)
+    out = {"BatchSizeOut": size, "BatchSumOut": ssum,
+           "BatchSquareSumOut": ssq}
+    if not attrs.get("is_test", False):
+        decay = attrs.get("summary_decay_rate", 0.9999999)
+        b = x.shape[0]
+        out = {"BatchSizeOut": decay * size + b,
+               "BatchSumOut": decay * ssum + jnp.sum(x, axis=0),
+               "BatchSquareSumOut": decay * ssq
+               + jnp.sum(jnp.square(x - mean), axis=0)}
+    out["X" + GRAD_SUFFIX] = dy * scale
+    return out
 
 
 @register_op("fused_softmax_mask")

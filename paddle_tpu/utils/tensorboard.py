@@ -1,0 +1,133 @@
+"""Hand-encoded TensorBoard event files — no tensorboard/protobuf deps.
+
+Role parity: the reference's VisualDL training visualization
+(``/root/reference/python/paddle/hapi/callbacks.py`` VisualDL callback).
+VisualDL itself is not in this build; TensorBoard's event format is the
+open equivalent every viewer reads, and its wire format is small enough
+to emit directly (the same trick as ``onnx/proto.py``):
+
+  * TFRecord framing: [len u64le][masked-crc32c(len)][payload]
+    [masked-crc32c(payload)], crc32c = Castagnoli polynomial;
+  * ``Event`` proto: wall_time (1, double), step (2, int64),
+    file_version (3, string) | summary (5, message);
+  * ``Summary.Value``: tag (1, string), simple_value (2, float).
+
+``SummaryWriter`` mirrors the tensorboardX/VisualDL ``add_scalar``
+surface, so ``tensorboard --logdir <dir>`` opens the output directly.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+from ..onnx.proto import f_bytes, f_string, f_varint
+
+__all__ = ["SummaryWriter"]
+
+# -- crc32c (Castagnoli, table-driven) --------------------------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ (poly if c & 1 else 0)
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _record(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (header + struct.pack("<I", _masked_crc(header))
+            + payload + struct.pack("<I", _masked_crc(payload)))
+
+
+# -- Event / Summary protos --------------------------------------------------
+
+
+def _f_double(field: int, value: float) -> bytes:
+    from ..onnx.proto import _tag
+
+    return _tag(field, 1) + struct.pack("<d", float(value))
+
+
+def _f_float32(field: int, value: float) -> bytes:
+    from ..onnx.proto import _tag
+
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def _event(wall_time: float, step: int = 0, file_version: str = None,
+           summary: bytes = None) -> bytes:
+    msg = _f_double(1, wall_time)
+    if step:
+        msg += f_varint(2, int(step))
+    if file_version is not None:
+        msg += f_string(3, file_version)
+    if summary is not None:
+        msg += f_bytes(5, summary)
+    return msg
+
+
+def _scalar_summary(tag: str, value: float) -> bytes:
+    val = f_string(1, tag) + _f_float32(2, value)
+    return f_bytes(1, val)
+
+
+class SummaryWriter:
+    """Minimal ``add_scalar`` writer producing real TB event files."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        host = socket.gethostname() or "host"
+        self._path = os.path.join(
+            log_dir, f"events.out.tfevents.{int(time.time())}.{host}")
+        self._f = open(self._path, "ab")
+        self._f.write(_record(_event(time.time(),
+                                     file_version="brain.Event:2")))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value, step: int = 0,
+                   walltime: float = None):
+        import numpy as np
+
+        v = float(np.asarray(
+            value.numpy() if hasattr(value, "numpy") else value))
+        self._f.write(_record(_event(
+            walltime if walltime is not None else time.time(),
+            step=step, summary=_scalar_summary(tag, v))))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

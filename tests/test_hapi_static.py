@@ -107,3 +107,24 @@ def test_static_requires_input_specs():
                               [np.zeros((2, 1), "int64")])
     finally:
         paddle.disable_static()
+
+
+def test_static_metrics_without_loss_evaluates():
+    """r4 advisor LOW: metrics-set/no-loss static Model — the eval program
+    must include the label vars its eval_batch feeds (they were created
+    after the predict clone)."""
+    x, y = _toy_data()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net,
+                  inputs=[InputSpec([None, 8], "float32", "x")],
+                  labels=[InputSpec([None, 1], "int64", "label")])
+    model.prepare(metrics=Accuracy())
+    paddle.enable_static()
+    try:
+        batches = [(x[i:i + 16], y[i:i + 16]) for i in range(0, len(x), 16)]
+        res = model.evaluate(batches, verbose=0)
+        assert "acc" in res
+        assert 0.0 <= float(res["acc"]) <= 1.0
+    finally:
+        paddle.disable_static()

@@ -386,15 +386,20 @@ def test_py_func_roundtrip():
         paddle.disable_static()
 
 
-def test_data_norm_accumulates_not_trains():
-    """Advisor-fix regression: the accumulator triple is persistable
-    non-trainable state that absorbs batch statistics each step."""
+def test_data_norm_accumulates_on_trained_steps_only():
+    """The accumulator triple moves on TRAINED steps (the update lives in
+    the grad op, data_norm_op.h parity) and fetch-only evaluation of the
+    same training-form program must NOT drift it (r4 advisor finding)."""
     paddle.enable_static()
     try:
         main, startup = static.Program(), static.Program()
         with static.program_guard(main, startup):
             x = static.data("x", [8, 4], "float32")
+            x.stop_gradient = False
             y = snn.data_norm(x, name="dn")
+            eval_prog = main.clone(for_test=True)
+            loss = paddle.mean(y * y)
+            static.append_backward(loss)
         # accumulators are NOT parameters (nothing for an optimizer to move)
         assert not any("batch_sum" in p.name or "batch_size" in p.name
                        for p in main.all_parameters())
@@ -402,8 +407,8 @@ def test_data_norm_accumulates_not_trains():
         exe.run(startup)
         rng = np.random.RandomState(5)
         xv = (rng.randn(8, 4) * 2 + 3).astype("float32")
-        for _ in range(200):
-            exe.run(main, feed={"x": xv}, fetch_list=[y])
+        for _ in range(200):  # TRAINED steps: loss fetched -> grad ops run
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
         out, ssum, ssize = exe.run(
             main, feed={"x": xv},
             fetch_list=[y, "dn.batch_sum", "dn.batch_size"])
@@ -411,12 +416,21 @@ def test_data_norm_accumulates_not_trains():
         # reference's 1e4 pseudo-count init damps them) and the output is
         # better centered than the raw input
         mean_est = np.asarray(ssum) / np.asarray(ssize)
-        assert float(np.asarray(ssize)[0]) > 1e4  # size accumulated
+        size_after_train = float(np.asarray(ssize)[0])
+        assert size_after_train > 1e4  # size accumulated
         true_mean = xv.mean(0)
         assert (np.sign(mean_est) == np.sign(true_mean)).all()
         assert (np.abs(mean_est) > 0.05 * np.abs(true_mean)).all()
         assert np.abs(np.asarray(out).mean(0)).max() \
             < np.abs(true_mean).max()
+        # evaluation through the test-form clone (the grad ops that carry
+        # the accumulator update are absent): statistics must not move
+        for _ in range(50):
+            exe.run(eval_prog, feed={"x": xv}, fetch_list=[y])
+        (ssize2,) = exe.run(eval_prog, feed={"x": xv},
+                            fetch_list=["dn.batch_size"])
+        np.testing.assert_allclose(float(np.asarray(ssize2)[0]),
+                                   size_after_train, rtol=1e-6)
     finally:
         paddle.disable_static()
 
