@@ -111,16 +111,21 @@ class Config:
     def enable_bf16(self):
         self._amp = "bfloat16"
 
-    def enable_int8(self, min_weight_elements: int = 1 << 16):
-        """Execute weight matmuls/convs as int8 x int8 -> int32 on the MXU
-        (static/quant_int8.py rewrite; the TRT int8 engine role).
+    def enable_int8(self, min_weight_elements: int = 1 << 16,
+                    quantize_convs: bool = False):
+        """Execute weight matmuls (and optionally convs) as int8 x int8 ->
+        int32 on the MXU (static/quant_int8.py rewrite; the TRT int8
+        engine role).
 
         ``min_weight_elements`` keeps small, bandwidth-bound layers on the
-        bf16 path — the int8 win (1.5x measured at 4096^3, BENCH extras)
-        needs enough MACs to amortize the quantize/dequant passes.  Pass 0
-        to quantize everything."""
+        bf16 path — the int8 GEMM win (1.5x at 4096^3, BENCH extras) needs
+        enough MACs to amortize the quantize/dequant passes.  Pass 0 to
+        quantize every matmul.  ``quantize_convs`` defaults OFF on
+        measurement: int8 conv through XLA on v5e is 0.79-1.13x vs bf16
+        at ResNet shapes (see quant_int8.rewrite_program_int8)."""
         self._int8 = True
         self._int8_min_elements = int(min_weight_elements)
+        self._int8_convs = bool(quantize_convs)
 
     def summary(self):
         return {"model": self._prefix, "device": self._device,
@@ -185,7 +190,8 @@ class Predictor:
                 self._program, self._scope,
                 fetch_names=list(self._fetch_names),
                 min_weight_elements=getattr(
-                    config, "_int8_min_elements", 1 << 16))
+                    config, "_int8_min_elements", 1 << 16),
+                quantize_convs=getattr(config, "_int8_convs", False))
         self._feeds: Dict[str, np.ndarray] = {}
         self._results: Dict[str, np.ndarray] = {}
 

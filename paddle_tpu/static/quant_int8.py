@@ -28,14 +28,22 @@ _FAKE_ACT_OPS = (
 
 
 def rewrite_program_int8(program, scope, fetch_names=None,
-                         min_weight_elements=1 << 16) -> int:
+                         min_weight_elements=1 << 16,
+                         quantize_convs=False) -> int:
     """Rewrite in place; returns the number of matmuls/convs quantized.
 
     ``min_weight_elements`` gates the rewrite to layers big enough for the
     int8 MXU path to win: the measured speedup (BENCH extras int8_matmul)
     is 1.5x at 4096^3 GEMMs, but small/bandwidth-bound layers pay the
     extra activation-quantize + dequant elementwise passes without
-    enough MACs to amortize them — those keep the bf16 path."""
+    enough MACs to amortize them — those keep the bf16 path.
+
+    ``quantize_convs`` is OFF by default on measurement, not principle:
+    int8 conv on v5e through the XLA conv path measured 0.79-1.13x vs
+    bf16 across ResNet-shape sweeps (256ch 14x14: 0.88x, 128ch 28x28:
+    0.79x, 1024ch 14x14: 1.08x) — the quantize/dequant passes eat the
+    MXU win at practical shapes.  Callers who want it anyway (e.g. for
+    memory, or future-chip int8 conv paths) opt in explicitly."""
     block = program.global_block()
     n = 0
     # map: activation var -> (producer fake-quant op, its frozen scale var)
@@ -61,8 +69,9 @@ def rewrite_program_int8(program, scope, fetch_names=None,
 
     for op in block.ops:
         if op.type == "conv2d":
-            n += _rewrite_conv(block, scope, op, fake_out, fake_weight,
-                               min_weight_elements)
+            if quantize_convs:
+                n += _rewrite_conv(block, scope, op, fake_out, fake_weight,
+                                   min_weight_elements)
             continue
         if op.type not in ("matmul_v2", "mul", "matmul"):
             continue

@@ -157,7 +157,7 @@ def test_int8_conv_rewrite_and_numerics(tmp_path):
              input_spec=[jit.InputSpec([2, 3, 8, 8], "float32", "x")])
 
     cfg = paddle_infer.Config(prefix)
-    cfg.enable_int8(min_weight_elements=0)
+    cfg.enable_int8(min_weight_elements=0, quantize_convs=True)
     pred = paddle_infer.create_predictor(cfg)
     types = [op.type for op in pred._program.global_block().ops]
     assert types.count("quantized_conv2d") == 2, types
@@ -167,3 +167,20 @@ def test_int8_conv_rewrite_and_numerics(tmp_path):
     # same accuracy contract as the matmul path (abs + rel band)
     assert np.all(np.abs(out - ref) < 0.05 + 0.05 * np.abs(ref)), (
         np.max(np.abs(out - ref)), np.abs(ref).max())
+
+
+def test_int8_convs_default_off(tmp_path):
+    """Conv quantization is opt-in (measured 0.79-1.13x on v5e): default
+    enable_int8 leaves conv2d ops on the bf16 path."""
+    paddle.seed(0)
+    model = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.Flatten(),
+                          nn.Linear(8 * 4 * 4, 2))
+    model.eval()
+    prefix = str(tmp_path / "c")
+    jit.save(model, prefix,
+             input_spec=[jit.InputSpec([1, 3, 4, 4], "float32", "x")])
+    cfg = paddle_infer.Config(prefix)
+    cfg.enable_int8(min_weight_elements=0)
+    pred = paddle_infer.create_predictor(cfg)
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "conv2d" in types and "quantized_conv2d" not in types
