@@ -744,3 +744,232 @@ for _n, (_why, _instead) in _PS_ERA.items():
 # drop placeholders that resolved to None (feature exists under another name)
 for _n in [k for k, v in list(globals().items()) if v is None]:
     globals()[_n] = _unsupported(_n, "not bound", "the paddle.nn 2.x API")
+
+
+# ---------------------------------------------------------------------------
+# surface completion: the remaining reference __all__ names (rnn.py decoder
+# classes, distributions, pool3d, losses, detection extras) — mapped to the
+# 2.x implementations where they exist, informative raises for PS-era ones
+# ---------------------------------------------------------------------------
+
+from ... import nn as _nn2  # noqa: E402
+
+RNNCell = _nn2.RNNCellBase
+GRUCell = _nn2.GRUCell
+LSTMCell = _nn2.LSTMCell
+BeamSearchDecoder = _nn2.BeamSearchDecoder
+dynamic_decode = _nn2.dynamic_decode
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Reference fluid.layers.rnn is a FUNCTION (cell, inputs, ...) ->
+    (outputs, final_states); the 2.x nn.RNN Layer runs it."""
+    runner = _nn2.RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return runner(inputs, initial_states=initial_states,
+                  sequence_length=sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    runner = _nn2.BiRNN(cell_fw, cell_bw, time_major=time_major)
+    return runner(inputs, initial_states=initial_states,
+                  sequence_length=sequence_length)
+
+from ...distribution import (  # noqa: E402,F401
+    Categorical, Normal, Uniform,
+)
+
+sequence_mask = F.sequence_mask
+triu = T.triu
+sigmoid_focal_loss = F.sigmoid_focal_loss
+kldiv_loss = F.kl_div
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """Reference warpctc → the 2.x CTC loss (per-sequence losses,
+    reduction='none' — the op's output shape).  The LoD calling mode
+    (lengths omitted) is not supported: this build's sequences are
+    padded+mask, so the padded-mode lengths are required."""
+    if input_length is None or label_length is None:
+        raise ValueError(
+            "fluid.layers.warpctc here requires input_length and "
+            "label_length (padded-tensor mode); the LoD mode has no "
+            "ragged runtime in the TPU-native build")
+    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
+                      reduction="none")
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    if path_table is not None or path_code is not None or is_custom:
+        raise NotImplementedError(
+            "fluid.layers.hsigmoid custom-tree mode (path_table/path_code) "
+            "is not wired; use the default complete-binary-tree mode or "
+            "paddle.nn.HSigmoidLoss directly")
+    layer = snn._reuse("hsigmoid", name, lambda: _nn2.HSigmoidLoss(
+        int(input.shape[-1]), num_classes, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return layer(input, label)
+
+
+def cos_sim(X, Y):
+    # reference returns [N, 1]
+    return T.unsqueeze(F.cosine_similarity(X, Y, axis=-1), [-1])
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    ndhwc = data_format == "NDHWC"
+    if ndhwc:  # the 3-D kernels are NCDHW; transpose around them
+        input = T.transpose(input, [0, 4, 1, 2, 3])
+    if global_pooling:
+        out = (T.max(input, axis=[2, 3, 4], keepdim=True)
+               if pool_type == "max"
+               else T.mean(input, axis=[2, 3, 4], keepdim=True))
+    elif pool_type == "max":
+        out = F.max_pool3d(input, kernel_size=pool_size, stride=pool_stride,
+                           padding=pool_padding, ceil_mode=ceil_mode)
+    else:
+        out = F.avg_pool3d(input, kernel_size=pool_size, stride=pool_stride,
+                           padding=pool_padding, ceil_mode=ceil_mode,
+                           exclusive=exclusive)
+    if ndhwc:
+        out = T.transpose(out, [0, 2, 3, 4, 1])
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    fn = (F.adaptive_max_pool3d if pool_type == "max"
+          else F.adaptive_avg_pool3d)
+    return fn(input, pool_size)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking (bpr_loss_op.h): for each row,
+    sum over j != label of -log(sigmoid(score_label - score_j)),
+    divided by (num_classes - 1)."""
+    n, c = input.shape[0], input.shape[-1]
+    idx = T.cast(T.reshape(label, [-1, 1]), "int64")
+    pos = T.gather_nd(input, T.concat([
+        T.unsqueeze(T.arange(0, n, 1, dtype="int64"), [-1]), idx], axis=-1))
+    diff = T.unsqueeze(pos, [-1]) - input
+    loss = -T.log(F.sigmoid(diff) + 1e-8)
+    # mask out the j == label term (the reference kernel skips it)
+    mask = T.cast(T.not_equal(
+        T.unsqueeze(T.arange(0, c, 1, dtype="int64"), [0]),
+        idx), loss.dtype)
+    return T.sum(loss * mask, axis=-1, keepdim=True) / float(int(c) - 1)
+
+
+def rank_loss(label, left, right, name=None):
+    """rank_loss_op.cc: C(o) = -o~*o + log(1 + exp(o)), o = left - right."""
+    o = left - right
+    return -label * o + T.log(1.0 + T.exp(o))
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU between [N,4] and [M,4] boxes (iou_similarity_op)."""
+    x1 = T.unsqueeze(x, [1])  # [N,1,4]
+    y1 = T.unsqueeze(y, [0])  # [1,M,4]
+    ixmin = T.maximum(x1[..., 0], y1[..., 0])
+    iymin = T.maximum(x1[..., 1], y1[..., 1])
+    ixmax = T.minimum(x1[..., 2], y1[..., 2])
+    iymax = T.minimum(x1[..., 3], y1[..., 3])
+    off = 0.0 if box_normalized else 1.0
+    iw = T.clip(ixmax - ixmin + off, 0.0, 1e10)
+    ih = T.clip(iymax - iymin + off, 0.0, 1e10)
+    inter = iw * ih
+    ax = ((x1[..., 2] - x1[..., 0] + off) * (x1[..., 3] - x1[..., 1] + off))
+    ay = ((y1[..., 2] - y1[..., 0] + off) * (y1[..., 3] - y1[..., 1] + off))
+    return inter / (ax + ay - inter + 1e-10)
+
+
+class Assert:
+    """fluid.layers.Assert(cond) — trace-time check on concrete values;
+    a symbolic condition raises via the Variable truthiness guard with
+    conversion guidance (assert inside jitted graphs is host-side)."""
+
+    def __new__(cls, cond, data=None, summarize=20, name=None):
+        import numpy as np
+
+        arr = (np.asarray(cond._array) if hasattr(cond, "_array")
+               else np.asarray(cond))
+        # reference Assert requires ALL elements true (assert_op.cc)
+        if not bool(np.all(arr)):
+            raise AssertionError(
+                f"fluid.layers.Assert failed (cond={arr.reshape(-1)[:summarize]})"
+                + (f"; data={data}" if data is not None else ""))
+        return cond
+
+
+_PS_ERA_2 = {
+    "MultivariateNormalDiag": ("moved in 2.x", "paddle.distribution"),
+    "BasicDecoder": ("the legacy seq2seq decoder kit",
+                     "paddle.nn.BeamSearchDecoder + dynamic_decode"),
+    "Decoder": ("the legacy seq2seq decoder kit",
+                "paddle.nn.BeamSearchDecoder + dynamic_decode"),
+    "DecodeHelper": ("the legacy seq2seq helper kit",
+                     "models.generation greedy/beam utilities"),
+    "TrainingHelper": ("the legacy seq2seq helper kit",
+                       "teacher forcing via plain layer calls"),
+    "GreedyEmbeddingHelper": ("the legacy seq2seq helper kit",
+                              "models.generation greedy decode"),
+    "SampleEmbeddingHelper": ("the legacy seq2seq helper kit",
+                              "models.generation sampling decode"),
+    "anchor_generator": ("a detection-era op", "vision.ops.prior_box"),
+    "density_prior_box": ("a detection-era op", "vision.ops.prior_box"),
+    "detection_output": ("a detection-era op",
+                         "vision.ops.multiclass_nms over decoded boxes"),
+    "matrix_nms": ("pending", "vision.ops.multiclass_nms"),
+    "locality_aware_nms": ("a niche OCR op", "vision.ops.multiclass_nms"),
+    "collect_fpn_proposals": ("a detection-era op",
+                              "vision.ops.distribute_fpn_proposals"),
+    "box_decoder_and_assign": ("a detection-era op", "vision.ops.box_coder"),
+    "polygon_box_transform": ("a niche OCR op", "explicit tensor ops"),
+    "roi_perspective_transform": ("a niche OCR op", "vision.ops.roi_align"),
+    "retinanet_detection_output": ("a detection-era op",
+                                   "vision.ops.multiclass_nms"),
+    "retinanet_target_assign": ("a detection-era op",
+                                "python-side target assignment"),
+    "rpn_target_assign": ("a detection-era op",
+                          "python-side target assignment"),
+    "generate_mask_labels": ("a detection-era op",
+                             "python-side target assignment"),
+    "generate_proposal_labels": ("a detection-era op",
+                                 "python-side target assignment"),
+    "ssd_loss": ("a detection-era composite", "explicit loss composition "
+                 "over vision.ops.iou/box utilities"),
+    "target_assign": ("a detection-era op",
+                      "python-side target assignment"),
+    "center_loss": ("a stateful-centers op",
+                    "an explicit centers buffer + mse update"),
+    "sampled_softmax_with_cross_entropy": (
+        "a sampling-softmax op", "full softmax_with_cross_entropy (the "
+        "50k-vocab chunked CE keeps it cheap on TPU)"),
+    "teacher_student_sigmoid_loss": ("a PS CTR loss",
+                                     "explicit sigmoid-loss composition"),
+    "edit_distance": ("a host-side metric", "python/numpy edit distance "
+                      "over decoded sequences"),
+    "create_py_reader_by_data": ("the legacy queue-feed reader",
+                                 "paddle.io.DataLoader"),
+    "reorder_lod_tensor_by_rank": ("a LoD-runtime op",
+                                   "the padded+mask sequence design"),
+    "autodoc": ("an internal doc decorator", "nothing — decorate directly"),
+    "templatedoc": ("an internal doc decorator",
+                    "nothing — decorate directly"),
+    "generate_activation_fn": ("an internal codegen helper",
+                               "paddle.nn.functional activations"),
+    "generate_inplace_fn": ("an internal codegen helper",
+                            "paddle tensor in-place methods"),
+    "generate_layer_fn": ("an internal codegen helper",
+                          "the public layer builders"),
+}
+
+for _n, (_why, _instead) in _PS_ERA_2.items():
+    if globals().get(_n) is None:
+        globals()[_n] = _unsupported(_n, _why, _instead)

@@ -248,3 +248,102 @@ def test_dy2static_zero_step_range_raises():
     conv = dy2static.convert_func(f)
     with pytest.raises(ValueError, match="must not be zero"):
         conv(paddle.to_tensor(np.asarray(1.0, "float32")))
+
+
+# ---------------------------------------------------------------------------
+# full fluid.layers surface (reference __all__ union, snapshotted)
+# ---------------------------------------------------------------------------
+
+# union of __all__ across /root/reference/python/paddle/fluid/layers/*.py
+REFERENCE_FLUID_LAYERS = ["Assert", "BasicDecoder", "BeamSearchDecoder", "Categorical", "DecodeHelper", "Decoder", "DynamicRNN", "GRUCell", "GreedyEmbeddingHelper", "IfElse", "LSTMCell", "MultivariateNormalDiag", "Normal", "Print", "RNNCell", "SampleEmbeddingHelper", "StaticRNN", "Switch", "TrainingHelper", "Uniform", "While", "accuracy", "adaptive_pool2d", "adaptive_pool3d", "add_position_encoding", "affine_channel", "affine_grid", "anchor_generator", "argmax", "argmin", "argsort", "array_length", "array_read", "array_write", "assign", "auc", "autodoc", "autoincreased_step_counter", "batch_norm", "beam_search", "beam_search_decode", "bilinear_tensor_product", "bipartite_match", "birnn", "box_clip", "box_coder", "box_decoder_and_assign", "bpr_loss", "brelu", "case", "cast", "center_loss", "chunk_eval", "clip", "clip_by_norm", "collect_fpn_proposals", "concat", "cond", "continuous_value_model", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose", "cos_sim", "cosine_decay", "create_array", "create_global_var", "create_parameter", "create_py_reader_by_data", "create_tensor", "crf_decoding", "crop", "crop_tensor", "cross_entropy", "ctc_greedy_decoder", "data", "data_norm", "deformable_conv", "deformable_roi_pooling", "density_prior_box", "detection_output", "diag", "dice_loss", "distribute_fpn_proposals", "double_buffer", "dropout", "dynamic_decode", "dynamic_gru", "dynamic_lstm", "dynamic_lstmp", "edit_distance", "elementwise_add", "elementwise_div", "elementwise_floordiv", "elementwise_max", "elementwise_min", "elementwise_mod", "elementwise_mul", "elementwise_pow", "elementwise_sub", "elu", "embedding", "equal", "expand", "expand_as", "exponential_decay", "eye", "fc", "fill_constant", "fill_constant_batch_size_like", "filter_by_instag", "flatten", "fsp_matrix", "gather", "gather_nd", "gather_tree", "gaussian_random", "gaussian_random_batch_size_like", "generate_activation_fn", "generate_inplace_fn", "generate_layer_fn", "generate_mask_labels", "generate_proposal_labels", "generate_proposals", "get_tensor_from_selected_rows", "greater_equal", "greater_than", "grid_sampler", "group_norm", "gru_unit", "hard_sigmoid", "hard_swish", "has_inf", "has_nan", "hash", "hsigmoid", "huber_loss", "im2sequence", "image_resize", "image_resize_short", "increment", "inplace_abn", "instance_norm", "inverse_time_decay", "iou_similarity", "is_empty", "isfinite", "kldiv_loss", "l2_normalize", "label_smooth", "layer_norm", "leaky_relu", "less_equal", "less_than", "linear_chain_crf", "linear_lr_warmup", "linspace", "load", "locality_aware_nms", "lod_append", "lod_reset", "log", "log_loss", "logical_and", "logical_not", "logical_or", "logical_xor", "lrn", "lstm", "lstm_unit", "margin_rank_loss", "matmul", "matrix_nms", "maxout", "mean", "mean_iou", "merge_selected_rows", "mish", "mse_loss", "mul", "multi_box_head", "multiclass_nms", "multiplex", "natural_exp_decay", "nce", "noam_decay", "not_equal", "npair_loss", "one_hot", "ones", "ones_like", "pad", "pad2d", "pad_constant_like", "piecewise_decay", "pixel_shuffle", "polygon_box_transform", "polynomial_decay", "pool2d", "pool3d", "pow", "prelu", "prior_box", "prroi_pool", "psroi_pool", "py_func", "py_reader", "random_crop", "range", "rank", "rank_loss", "read_file", "reduce_all", "reduce_any", "reduce_max", "reduce_mean", "reduce_min", "reduce_prod", "reduce_sum", "relu", "relu6", "reorder_lod_tensor_by_rank", "reshape", "resize_bilinear", "resize_linear", "resize_nearest", "resize_trilinear", "retinanet_detection_output", "retinanet_target_assign", "reverse", "rnn", "roi_align", "roi_perspective_transform", "roi_pool", "row_conv", "rpn_target_assign", "sampled_softmax_with_cross_entropy", "sampling_id", "scale", "scatter", "scatter_nd", "scatter_nd_add", "selu", "sequence_concat", "sequence_conv", "sequence_enumerate", "sequence_expand", "sequence_expand_as", "sequence_first_step", "sequence_last_step", "sequence_mask", "sequence_pad", "sequence_pool", "sequence_reshape", "sequence_reverse", "sequence_scatter", "sequence_slice", "sequence_softmax", "sequence_unpad", "shape", "shard_index", "shuffle_channel", "sigmoid_cross_entropy_with_logits", "sigmoid_focal_loss", "sign", "similarity_focus", "size", "slice", "smooth_l1", "soft_relu", "softmax", "softmax_with_cross_entropy", "space_to_depth", "spectral_norm", "split", "square_error_cost", "squeeze", "ssd_loss", "stack", "stanh", "strided_slice", "sum", "sums", "swish", "switch_case", "target_assign", "teacher_student_sigmoid_loss", "templatedoc", "temporal_shift", "tensor_array_to_tensor", "topk", "transpose", "triu", "unbind", "unfold", "uniform_random", "uniform_random_batch_size_like", "unique", "unique_with_counts", "unsqueeze", "unstack", "warpctc", "where", "while_loop", "yolo_box", "yolov3_loss", "zeros", "zeros_like"]
+
+
+def test_fluid_layers_full_reference_surface():
+    missing = [n for n in REFERENCE_FLUID_LAYERS
+               if not hasattr(fluid.layers, n)]
+    assert not missing, f"fluid.layers missing: {missing}"
+
+
+def test_fluid_layers_new_adapters_behave():
+    with fluid.dygraph.guard():
+        x = fluid.dygraph.to_variable(
+            np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "float32"))
+        y = fluid.dygraph.to_variable(
+            np.array([[0, 0, 2, 2]], "float32"))
+        iou = fluid.layers.iou_similarity(x, y).numpy()
+        assert abs(iou[0, 0] - 1.0) < 1e-6
+        assert abs(iou[1, 0] - (1.0 / 7.0)) < 1e-6  # inter 1, union 7
+
+        label = fluid.dygraph.to_variable(np.array([[1.0]], "float32"))
+        left = fluid.dygraph.to_variable(np.array([[2.0]], "float32"))
+        right = fluid.dygraph.to_variable(np.array([[0.0]], "float32"))
+        rl = float(fluid.layers.rank_loss(label, left, right).numpy())
+        assert abs(rl - (-2.0 + np.log1p(np.exp(2.0)))) < 1e-5
+
+        t = fluid.layers.triu(fluid.dygraph.to_variable(
+            np.ones((3, 3), "float32")))
+        assert float(t.numpy().sum()) == 6.0
+
+        img = fluid.dygraph.to_variable(
+            np.random.RandomState(0).randn(1, 2, 4, 4, 4).astype("float32"))
+        p = fluid.layers.pool3d(img, pool_size=2, pool_stride=2)
+        assert p.shape == [1, 2, 2, 2, 2]
+
+        fluid.layers.Assert(fluid.dygraph.to_variable(
+            np.asarray(True)))
+        with pytest.raises(AssertionError):
+            fluid.layers.Assert(fluid.dygraph.to_variable(
+                np.asarray(False)))
+
+    # decoder/distribution names resolve to the 2.x classes
+    from paddle_tpu import nn as nn2
+    assert fluid.layers.GRUCell is nn2.GRUCell
+    assert fluid.layers.BeamSearchDecoder is nn2.BeamSearchDecoder
+    from paddle_tpu import distribution as D
+    assert fluid.layers.Normal is D.Normal
+    # PS-era names raise with guidance
+    with pytest.raises(NotImplementedError, match="multiclass_nms"):
+        fluid.layers.matrix_nms(None, None, 0.1, 10, 10)
+
+
+def test_fluid_layers_rnn_function_and_losses():
+    """The review-driven adapter checks: rnn() as a FUNCTION, bpr_loss
+    matching the op formula, hsigmoid callable, warpctc lengths guard."""
+    from paddle_tpu import nn as nn2
+
+    with fluid.dygraph.guard():
+        paddle.seed(0)
+        cell = nn2.SimpleRNNCell(4, 8)
+        x = fluid.dygraph.to_variable(
+            np.random.RandomState(0).randn(2, 5, 4).astype("float32"))
+        outs, final = fluid.layers.rnn(cell, x)
+        assert outs.shape == [2, 5, 8]
+
+        # bpr_loss vs the bpr_loss_op.h formula
+        inp = fluid.dygraph.to_variable(
+            np.array([[2.0, 0.5, -1.0]], "float32"))
+        lab = fluid.dygraph.to_variable(np.array([[0]], "int64"))
+        got = float(fluid.layers.bpr_loss(inp, lab).numpy())
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        ref = -(np.log(sig(2.0 - 0.5) + 1e-8)
+                + np.log(sig(2.0 + 1.0) + 1e-8)) / 2.0
+        assert abs(got - ref) < 1e-5
+
+        h = fluid.layers.hsigmoid(
+            fluid.dygraph.to_variable(
+                np.random.RandomState(1).randn(3, 4).astype("float32")),
+            fluid.dygraph.to_variable(np.array([[1], [2], [0]], "int64")),
+            num_classes=6)
+        assert np.isfinite(h.numpy()).all()
+
+        with pytest.raises(ValueError, match="input_length"):
+            fluid.layers.warpctc(inp, lab)
+
+        cs = fluid.layers.cos_sim(
+            fluid.dygraph.to_variable(np.ones((3, 4), "float32")),
+            fluid.dygraph.to_variable(np.ones((3, 4), "float32")))
+        assert cs.shape == [3, 1]
+
+        with pytest.raises(AssertionError):
+            fluid.layers.Assert(fluid.dygraph.to_variable(
+                np.array([True, False])))
