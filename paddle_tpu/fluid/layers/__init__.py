@@ -321,6 +321,10 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
 
 def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
                     name=None):
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index needs pool_type='max'")
+        return F.adaptive_max_pool2d(input, pool_size, return_mask=True)
     fn = (F.adaptive_max_pool2d if pool_type == "max"
           else F.adaptive_avg_pool2d)
     return fn(input, pool_size)
@@ -797,6 +801,10 @@ def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
             "fluid.layers.warpctc here requires input_length and "
             "label_length (padded-tensor mode); the LoD mode has no "
             "ragged runtime in the TPU-native build")
+    if norm_by_times:
+        raise NotImplementedError(
+            "fluid.layers.warpctc norm_by_times=True is not wired; divide "
+            "the returned per-sequence losses by input_length instead")
     return F.ctc_loss(input, label, input_length, label_length, blank=blank,
                       reduction="none")
 
@@ -844,6 +852,10 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
 
 def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
                     name=None):
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool3d(require_index=True) (argmax indices) is not "
+            "wired; use the values-only form")
     fn = (F.adaptive_max_pool3d if pool_type == "max"
           else F.adaptive_avg_pool3d)
     return fn(input, pool_size)

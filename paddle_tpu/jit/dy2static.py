@@ -12,9 +12,11 @@ program as ``lax.cond`` / ``lax.while_loop`` / ``lax.fori_loop`` (counted
 loops are recognized and become reverse-differentiable ``fori``).  The
 transformed code dispatches at RUNTIME: a Python-bool condition runs as
 plain Python (trace-time unrolling — jax semantics), a ``Variable``
-condition becomes a real in-graph branch/loop.  Unconvertible patterns
-(``break``/``contin`` inside a converted loop, ``return`` from one branch
-only) raise :class:`ConversionError` naming the source line.
+condition becomes a real in-graph branch/loop.  ``break``/``continue``
+convert via per-loop flags, ``return`` inside a loop via per-site flags
+with the return expression deferred past the loop, and list append/pop
+dispatch at runtime; the remaining unconvertible patterns raise
+:class:`ConversionError` naming the source line.
 """
 
 from __future__ import annotations
@@ -198,6 +200,21 @@ def loop_test(test, brk):
         return T.logical_and(T.cast(t, "bool"),
                              T.logical_not(T.cast(b, "bool")))
     return _truth(test) and not _truth(brk)
+
+
+def any_flag(*flags):
+    """Logical OR of break/return flags — symbolic-safe (python `not`/`or`
+    on a Variable would hit the __bool__ guard)."""
+    if any(_is_symbolic(f) for f in flags):
+        from .. import tensor_api as T
+
+        acc = None
+        for f in flags:
+            fv = f if _is_symbolic(f) else _promote(bool(_truth(f)))
+            fv = T.cast(fv, "bool")
+            acc = fv if acc is None else T.logical_or(acc, fv)
+        return acc
+    return any(_truth(f) for f in flags)
 
 
 def flags_clear(*flags):
@@ -500,6 +517,110 @@ def _loop_has_break(body) -> bool:
     return False
 
 
+class _ReturnInLoopTransformer(ast.NodeTransformer):
+    """``return`` inside a loop (reference return_transformer role).
+
+    Each return SITE gets its own flag; the return EXPRESSION is deferred
+    to after the outermost enclosing loop:
+
+        return e_k       ->  _retf_k = True; break
+        <inner loop>     ->  <inner loop>; if not flags_clear(...): break
+        <top loop>       ->  <top loop>;  if _retf_k: return e_k  (per k)
+
+    Deferring ``e_k`` is exact because the synthesized break exits every
+    loop level immediately — the locals ``e_k`` reads hold their values
+    from the break iteration (they are the loop carries at exit).  This
+    sidesteps carrying a value of unknown structure through a tensor-
+    bounded while_loop: only boolean flags ride the carry, and the
+    at-most-one-true flag picks the deferred expression after the loop
+    (the per-site ifs chain through _normalize_tail's return merging)."""
+
+    def __init__(self):
+        self.depth = 0
+        self.ctr = 0
+        # per-loop-nesting stack of flag names created under that loop
+        self.loop_flags: List[List[str]] = []
+        # flags created at depth 1 loops (emit return-guards at top level)
+        self.pending: List[tuple] = []
+        self.rewrote = False
+
+    def visit_FunctionDef(self, node):  # nested scopes keep their returns
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Return(self, node: ast.Return):
+        if self.depth == 0:
+            return node
+        self.rewrote = True
+        self.ctr += 1
+        flag = f"_retf{self.ctr}"
+        for level in self.loop_flags:
+            level.append(flag)
+        value = node.value if node.value is not None else ast.Constant(
+            value=None)
+        self.pending.append((flag, value))
+        return [
+            ast.copy_location(ast.Assign(
+                targets=[_name(flag, ast.Store())],
+                value=ast.Constant(value=True)), node),
+            ast.copy_location(ast.Break(), node),
+        ]
+
+    def _visit_loop(self, node):
+        self.depth += 1
+        self.loop_flags.append([])
+        self.generic_visit(node)
+        flags = self.loop_flags.pop()
+        self.depth -= 1
+        if not flags:
+            return node
+        if self.depth > 0:
+            # propagate the exit outward: break the enclosing loop too
+            guard: ast.stmt = ast.If(
+                test=ast.Call(func=_helper("any_flag"),
+                              args=[_name(f) for f in flags], keywords=[]),
+                body=[ast.Break()], orelse=[])
+            return [node, ast.copy_location(guard, node)]
+        # top level: one deferred-return guard per site (mutually exclusive
+        # — a break exits every level before another site can fire)
+        out: List[ast.stmt] = [node]
+        for flag, value in self.pending:
+            if flag in flags:
+                out.append(ast.copy_location(ast.If(
+                    test=_name(flag),
+                    body=[ast.Return(value=value)], orelse=[]), node))
+        self.pending = [(f, v) for f, v in self.pending if f not in flags]
+        return out
+
+    def visit_While(self, node):
+        return self._visit_loop(node)
+
+    def visit_For(self, node):
+        return self._visit_loop(node)
+
+
+def _rewrite_returns_in_loops(fdef: ast.FunctionDef) -> None:
+    t = _ReturnInLoopTransformer()
+    # transform the BODY statements (visit(fdef) would hit the nested-
+    # scope skip on the function node itself)
+    new_body: List[ast.stmt] = []
+    for st in fdef.body:
+        r = t.visit(st)
+        new_body.extend(r if isinstance(r, list) else [r])
+    fdef.body = new_body
+    if t.rewrote:
+        fdef.body = [
+            ast.Assign(targets=[_name(f"_retf{k}", ast.Store())],
+                       value=ast.Constant(value=False))
+            for k in range(1, t.ctr + 1)
+        ] + fdef.body
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     """Rewrite if/while/for statements into runtime-dispatched helpers."""
 
@@ -772,6 +893,7 @@ def _convert_uncached(fn: Callable) -> Callable:
         return fn
 
     fdef.decorator_list = []  # drop @to_static etc. — we are past them
+    _rewrite_returns_in_loops(fdef)  # return-in-loop -> flags + break
     fdef.body = _normalize_tail(fdef.body)
     filename = getattr(inspect.getmodule(fn), "__file__", None) or "<dy2st>"
     new_tree = _ControlFlowTransformer(filename).visit(tree)

@@ -154,9 +154,9 @@ def test_nested_if_inside_while():
     assert out[0] == 4.0
 
 
-def test_return_inside_loop_raises_conversion_error():
-    """break/continue convert now (flag machinery); `return` inside a
-    convertible loop is the remaining unsupported exit."""
+def test_return_inside_tensor_while_converts():
+    """`return` inside a loop converts via the return-flag machinery
+    (reference return_transformer role)."""
 
     def fn(x):
         s = paddle.zeros([1])
@@ -166,21 +166,44 @@ def test_return_inside_loop_raises_conversion_error():
                 return s
         return s
 
-    with pytest.raises(dy2static.ConversionError, match="return"):
-        dy2static.convert_func(fn)
+    out = _run_both(fn, np.zeros((1,), "float32"))
+    assert out[0] == 3.0
 
 
-def test_one_branch_return_deep_raises():
+def test_return_in_loop_branch_converts():
     def fn(x):
         s = paddle.zeros([1])
         while s.sum() < 3.0:
             if x.sum() > 0:
-                return s
+                return s - 100.0
             s = s + 1.0
         return s
 
-    with pytest.raises(dy2static.ConversionError, match="return"):
-        dy2static.convert_func(fn)
+    assert _run_both(fn, np.ones((1,), "float32"))[0] == -100.0
+    assert _run_both(fn, -np.ones((1,), "float32"))[0] == 3.0
+
+
+def test_return_in_python_for_loop():
+    def fn(x):
+        for i in range(10):
+            x = x + 1.0
+            if i == 2:
+                return x * 10.0
+        return x
+
+    assert _run_both(fn, np.zeros((1,), "float32"))[0] == 30.0
+
+
+def test_return_in_nested_loop():
+    def fn(x):
+        for i in range(3):
+            for j in range(4):
+                x = x + 1.0
+                if x.sum() >= 5.0:
+                    return x
+        return x
+
+    assert _run_both(fn, np.zeros((1,), "float32"))[0] == 5.0
 
 
 def test_layer_forward_converts():
