@@ -108,3 +108,93 @@ def load_op_library(*a, **k):
     raise NotImplementedError(
         "fluid.load_op_library loads CUDA .so custom ops; use "
         "paddle_tpu.utils.cpp_extension (C++ + pure_callback) instead.")
+
+
+# -- remaining reference fluid.__all__ names --------------------------------
+
+from ..framework.place import NPUPlace, XPUPlace  # noqa: E402,F401
+from .. import profiler  # noqa: E402,F401
+from ..dygraph.tensor import Tensor  # noqa: E402,F401
+
+
+class LoDTensor:
+    """Compat alias: LoD tensors are padded+mask in this build (see
+    ops/sequence_ops.py design note); a plain Tensor carries the data."""
+
+    def __new__(cls, *a, **k):
+        import numpy as np
+
+        return Tensor(np.zeros([0], "float32")) if not a else Tensor(a[0])
+
+
+LoDTensorArray = list  # dygraph semantics: a python list of Tensors
+
+
+class DataFeeder:
+    """Parity: fluid/data_feeder.py — converts per-sample rows into the
+    feed dict the Executor takes."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self._names = [getattr(v, "name", str(v)) for v in feed_list]
+
+    def feed(self, iterable):
+        import numpy as np
+
+        cols = list(zip(*iterable))
+        if len(cols) != len(self._names):
+            raise ValueError(
+                f"DataFeeder got {len(cols)} columns for "
+                f"{len(self._names)} feed vars")
+        return {n: np.stack([np.asarray(v) for v in c])
+                for n, c in zip(self._names, cols)}
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Parity: fluid.save — persistables of a Program to one file."""
+    import numpy as np
+
+    from ..framework import program as fw
+    from ..framework.scope import global_scope
+
+    state = {}
+    for var in program.global_block().vars.values():
+        if getattr(var, "persistable", False):
+            val = global_scope().find_var(var.name)
+            if val is not None:
+                state[var.name] = np.asarray(val)
+    np.savez(model_path + ".pdparams.npz", **state)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Parity: fluid.load — restore persistables saved by fluid.save."""
+    import numpy as np
+
+    from ..framework.scope import global_scope
+
+    data = np.load(model_path + ".pdparams.npz")
+    names = set(var_list) if var_list else None
+    for name in data.files:
+        if names is None or name in names:
+            global_scope().set(name, data[name])
+
+
+def install_check():
+    """Parity: fluid.install_check.run_check."""
+    from ..utils import run_check
+
+    return run_check()
+
+
+def _cuda_synchronize(place=None):
+    """No-op: XLA execution is synchronized at fetch (block_until_ready)."""
+
+
+class _TranspilerUnavailable:
+    def __getattr__(self, name):
+        raise NotImplementedError(
+            "fluid.transpiler is the parameter-server-era program rewriter; "
+            "the collective path (paddle.distributed.fleet) replaces it in "
+            "the TPU-native build.")
+
+
+transpiler = _TranspilerUnavailable()

@@ -347,3 +347,46 @@ def test_fluid_layers_rnn_function_and_losses():
         with pytest.raises(AssertionError):
             fluid.layers.Assert(fluid.dygraph.to_variable(
                 np.array([True, False])))
+
+
+def test_fluid_top_level_reference_names_and_save_load(tmp_path):
+    """The explicit names fluid/__init__.py exports beyond submodule
+    __all__s, plus fluid.save/load + DataFeeder round-trips."""
+    for n in ["io", "initializer", "embedding", "one_hot", "layers",
+              "contrib", "data", "dygraph", "enable_dygraph",
+              "disable_dygraph", "transpiler", "nets", "optimizer",
+              "backward", "regularizer", "LoDTensor", "LoDTensorArray",
+              "CPUPlace", "XPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+              "NPUPlace", "Tensor", "ParamAttr", "WeightNormParamAttr",
+              "DataFeeder", "clip", "profiler", "unique_name", "Scope",
+              "install_check", "save", "load", "_cuda_synchronize"]:
+        assert hasattr(fluid, n), n
+
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            out = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        fluid.save(main, str(tmp_path / "m"))
+        from paddle_tpu.framework.scope import global_scope
+
+        for v in main.global_block().vars.values():
+            if getattr(v, "persistable", False):
+                global_scope().set(v.name, np.zeros(v.shape, "float32"))
+        fluid.load(main, str(tmp_path / "m"))
+        back, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(back))
+
+        feeder = fluid.DataFeeder(feed_list=[x], place=fluid.CPUPlace())
+        fd = feeder.feed([(xv[0],), (xv[1],)])
+        assert fd["x"].shape == (2, 4)
+    finally:
+        paddle.disable_static()
+
+    with pytest.raises(NotImplementedError, match="fleet"):
+        fluid.transpiler.DistributeTranspiler
