@@ -1,0 +1,7 @@
+"""``paddle.fluid.dygraph.base`` module alias (guard/to_variable/
+enabled/no_grad live here in the reference).
+
+Parity: ``/root/reference/python/paddle/fluid/dygraph/base.py``.
+"""
+
+from . import enabled, guard, no_grad, to_variable  # noqa: F401

@@ -1,0 +1,11 @@
+"""``paddle.fluid.dygraph.nn`` module alias — v2.1 scripts import the
+layer classes from here (``from paddle.fluid.dygraph.nn import Linear``).
+
+Parity: ``/root/reference/python/paddle/fluid/dygraph/nn.py``.
+"""
+
+from . import (  # noqa: F401
+    BatchNorm, BilinearTensorProduct, Conv2D, Conv2DTranspose, Dropout,
+    Embedding, GroupNorm, LayerNorm, Linear, NCE, Pool2D, PRelu,
+    SpectralNorm,
+)

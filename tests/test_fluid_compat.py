@@ -407,3 +407,17 @@ def test_fluid_word2vec_example_trains(monkeypatch):
     monkeypatch.setattr(sys, "argv", ["fluid_word2vec.py", "--steps", "30"])
     losses = mod.main()  # main() asserts the loss-decrease contract itself
     assert len(losses) == 30
+
+
+def test_fluid_dygraph_nn_module_imports():
+    """v2.1 import form: from paddle.fluid.dygraph.nn import Linear..."""
+    from paddle_tpu.fluid.dygraph.nn import (
+        BatchNorm, Conv2D, Embedding, Linear, Pool2D,
+    )
+    from paddle_tpu.fluid.dygraph.base import guard, to_variable
+
+    with guard():
+        fc = Linear(3, 2)
+        out = fc(to_variable(np.ones((1, 3), "float32")))
+        assert out.shape == [1, 2]
+    assert all(c is not None for c in (BatchNorm, Conv2D, Embedding, Pool2D))
