@@ -35,6 +35,10 @@ def main():
     p.add_argument("--sharding-stage", type=int, default=None)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--remat", default="0", choices=["0", "1", "dots"])
+    p.add_argument("--seq-major", action="store_true",
+                   help="[S, B, H] activation layout end-to-end "
+                        "(GPTConfig.seq_major; feeds the sbnd flash entry "
+                        "with zero layout transposes)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -62,7 +66,7 @@ def main():
     cfg_fn = {"tiny": gpt_mod.gpt_tiny, "small": gpt_mod.gpt_small,
               "medium": gpt_mod.gpt_medium, "1p3b": gpt_mod.gpt_1p3b,
               "13b": gpt_mod.gpt_13b}[args.config]
-    cfg = cfg_fn(use_parallel=args.mp > 1)
+    cfg = cfg_fn(use_parallel=args.mp > 1, seq_major=args.seq_major)
     seq = args.seq or min(cfg.max_seq_len, 512)
 
     paddle.seed(args.seed)

@@ -184,19 +184,22 @@ class HDFSClient(FS):
                 "mount (GCS-fuse/NFS), which is the TPU-pod deployment "
                 "path")
 
-    def _run(self, *args, ok_codes=(0,)):
+    def _run(self, *args, ok_codes=(0,), binary=False):
         import subprocess
 
         cmd = [self._hadoop, "fs"] + self._configs + list(args)
         try:
-            proc = subprocess.run(cmd, capture_output=True, text=True,
+            proc = subprocess.run(cmd, capture_output=True, text=not binary,
                                   timeout=self._timeout)
         except subprocess.TimeoutExpired as e:
             raise FSTimeOut(f"{' '.join(cmd)} timed out") from e
         if proc.returncode not in ok_codes:
+            err = proc.stderr
+            if binary:
+                err = err.decode("utf-8", "replace")
             raise ExecuteError(
                 f"{' '.join(cmd)} failed (rc={proc.returncode}): "
-                f"{proc.stderr.strip()[:500]}")
+                f"{err.strip()[:500]}")
         return proc.returncode, proc.stdout
 
     def ls_dir(self, fs_path):
@@ -229,11 +232,20 @@ class HDFSClient(FS):
     def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
         if not os.path.exists(local_path):
             raise FSFileNotExistsError(local_path)
-        if self.is_exist(fs_path):
-            if not overwrite:
+        if not overwrite:
+            if self.is_exist(fs_path):
                 raise FSFileExistsError(fs_path)
+            # plain -put (no -f): a concurrent writer racing past the
+            # is_exist check still fails loudly instead of clobbering
+            self._run("-put", local_path, fs_path)
+            return
+        if self.is_dir(fs_path):
+            # '-put -f file dir' would nest the file INSIDE the directory;
+            # only a directory target needs the explicit delete
             self.delete(fs_path)
-        self._run("-put", local_path, fs_path)
+        # -put -f overwrites a file atomically on the NameNode; the previous
+        # delete-then-put left a window with NO file if the put failed
+        self._run("-put", "-f", local_path, fs_path)
 
     def download(self, fs_path, local_path, multi_processes=1,
                  overwrite=False):
@@ -268,11 +280,15 @@ class HDFSClient(FS):
             return
         self._run("-touchz", fs_path)
 
-    def cat(self, fs_path):
+    def cat(self, fs_path, binary=False):
+        """File contents; ``binary=True`` returns raw bytes.  The default
+        decodes on demand (replacement chars instead of raising), so
+        catting a binary checkpoint can never throw UnicodeDecodeError
+        mid-pipeline."""
         if not self.is_exist(fs_path):
-            return ""
-        _, out = self._run("-cat", fs_path)
-        return out
+            return b"" if binary else ""
+        _, out = self._run("-cat", fs_path, binary=True)
+        return out if binary else out.decode("utf-8", "replace")
 
     def need_upload_download(self):
         return True

@@ -5,6 +5,8 @@ Uniform, Normal, TruncatedNormal, Xavier, Bilinear, MSRA + the *Initializer
 aliases and set_global_initializer).
 """
 
+import abc
+
 from ..nn import initializer as _init
 
 Constant = ConstantInitializer = _init.Constant
@@ -13,29 +15,46 @@ Normal = NormalInitializer = _init.Normal
 TruncatedNormal = TruncatedNormalInitializer = _init.TruncatedNormal
 
 
-class Xavier(_init.Initializer):
-    """Reference XavierInitializer: ``uniform=True`` by DEFAULT (the 2.x
-    split classes are XavierUniform/XavierNormal).  A class (not a
-    factory) so isinstance/subclass checks on the compat name keep
-    working; __new__ returns the matching 2.x variant."""
+class _CompatInitMeta(abc.ABCMeta):
+    """Metaclass for the v2.1 compat initializer names: ``__call__`` builds
+    the matching 2.x variant, while ABCMeta's ``register`` makes that
+    variant a VIRTUAL subclass — so ``isinstance(Xavier(), Xavier)`` and
+    ``isinstance(XavierUniform(), Xavier)`` both hold even though the
+    constructed object is a 2.x instance."""
 
-    def __new__(cls, uniform=True, fan_in=None, fan_out=None, seed=0):
-        if cls is not Xavier:
-            return super().__new__(cls)
+    def __call__(cls, *args, **kwargs):
+        if "_build" in vars(cls):  # the compat class itself, not a subclass
+            return cls._build(*args, **kwargs)
+        return super().__call__(*args, **kwargs)
+
+
+class Xavier(_init.Initializer, metaclass=_CompatInitMeta):
+    """Reference XavierInitializer: ``uniform=True`` by DEFAULT (the 2.x
+    split classes are XavierUniform/XavierNormal)."""
+
+    @staticmethod
+    def _build(uniform=True, fan_in=None, fan_out=None, seed=0):
         impl = _init.XavierUniform if uniform else _init.XavierNormal
         return impl(fan_in=fan_in, fan_out=fan_out)
 
 
-class MSRA(_init.Initializer):
+Xavier.register(_init.XavierUniform)
+Xavier.register(_init.XavierNormal)
+
+
+class MSRA(_init.Initializer, metaclass=_CompatInitMeta):
     """Reference MSRAInitializer: ``uniform=True`` by default."""
 
-    def __new__(cls, uniform=True, fan_in=None, seed=0, negative_slope=0.0,
-                nonlinearity="relu"):
-        if cls is not MSRA:
-            return super().__new__(cls)
+    @staticmethod
+    def _build(uniform=True, fan_in=None, seed=0, negative_slope=0.0,
+               nonlinearity="relu"):
         impl = _init.KaimingUniform if uniform else _init.KaimingNormal
         return impl(fan_in=fan_in, negative_slope=negative_slope,
                     nonlinearity=nonlinearity)
+
+
+MSRA.register(_init.KaimingUniform)
+MSRA.register(_init.KaimingNormal)
 
 
 XavierInitializer = Xavier

@@ -395,8 +395,23 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 
 
 def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
-    return F.smooth_l1_loss(x, y, reduction="none", delta=1.0 / (
-        (sigma or 1.0) ** 2))
+    """v2.1 smooth_l1_loss op semantics (smooth_l1_loss_op.cc): the diff is
+    scaled by sigma^2 inside the huber branch point, weights multiply the
+    diff (inside) / the loss (outside), and the loss is SUMMED over every
+    non-batch dim — output shape [N, 1]."""
+    sigma2 = float(sigma if sigma is not None else 1.0) ** 2
+    diff = T.subtract(x, y)
+    if inside_weight is not None:
+        diff = T.multiply(diff, inside_weight)
+    ad = T.abs(diff)
+    inv = 1.0 / sigma2
+    quad = T.scale(T.multiply(diff, diff), 0.5 * sigma2)
+    lin = T.subtract(ad, T.full_like(ad, 0.5 * inv))
+    loss = T.where(T.less_than(ad, T.full_like(ad, inv)), quad, lin)
+    if outside_weight is not None:
+        loss = T.multiply(loss, outside_weight)
+    n = loss.shape[0]
+    return T.sum(T.reshape(loss, [n, -1]), axis=1, keepdim=True)
 
 
 # ---------------------------------------------------------------------------
@@ -413,9 +428,22 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 softmax_with_cross_entropy = F.softmax_with_cross_entropy
 square_error_cost = F.square_error_cost
-sigmoid_cross_entropy_with_logits = (
-    lambda x, label, ignore_index=-100, name=None, normalize=False:
-    F.binary_cross_entropy_with_logits(x, label, reduction="none"))
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    """v2.1 op semantics (sigmoid_cross_entropy_with_logits_op.cc):
+    elementwise BCE-with-logits, positions where ``label == ignore_index``
+    contribute 0, and ``normalize=True`` divides by the count of
+    non-ignored elements (not the total)."""
+    loss = F.binary_cross_entropy_with_logits(x, label, reduction="none")
+    keep = T.cast(T.not_equal(label, T.full_like(label, ignore_index)),
+                  loss.dtype)
+    loss = T.multiply(loss, keep)
+    if normalize:
+        total = T.sum(keep)
+        loss = T.divide(loss, T.maximum(total, T.full_like(total, 1.0)))
+    return loss
 log_loss = F.log_loss if hasattr(F, "log_loss") else None
 mse_loss = F.mse_loss
 kldiv_loss = F.kl_div
@@ -669,6 +697,102 @@ def _unsupported(name, why, instead):
     return raiser
 
 
+# ---------------------------------------------------------------------------
+# v2.1 names wired to their existing 2.x implementations (arg order is the
+# fluid one; the bodies are the 2.x ops)
+# ---------------------------------------------------------------------------
+
+
+def grid_sampler(x, grid, name=None):
+    """fluid.layers.grid_sampler — bilinear + zeros padding + align_corners
+    (the only mode the v2.1 op exposed)."""
+    return F.grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                         align_corners=True)
+
+
+temporal_shift = F.temporal_shift
+
+
+def affine_grid(theta, out_shape, name=None):
+    """fluid.layers.affine_grid — v2.1 had no align_corners knob (True)."""
+    return F.affine_grid(theta, out_shape, align_corners=True)
+
+
+gather_tree = F.gather_tree
+multiplex = T.multiplex
+
+
+def mean_iou(input, label, num_classes):
+    """fluid.layers.mean_iou (mean_iou_op) — returns
+    ``(mean_iou, out_wrong, out_correct)``: per-class wrong/correct counts
+    (a mismatch increments BOTH classes' wrong counters) and the IoU mean
+    over classes that appear at all."""
+    from ...dygraph import tracer
+
+    def fn(pred, lab):
+        import jax.numpy as jnp
+
+        pred = pred.reshape(-1).astype(jnp.int64)
+        lab = lab.reshape(-1).astype(jnp.int64)
+        hit = pred == lab
+        correct = jnp.bincount(jnp.where(hit, pred, num_classes),
+                               length=num_classes + 1)[:num_classes]
+        wrong = (jnp.bincount(jnp.where(hit, num_classes, pred),
+                              length=num_classes + 1)[:num_classes]
+                 + jnp.bincount(jnp.where(hit, num_classes, lab),
+                                length=num_classes + 1)[:num_classes])
+        denom = correct + wrong
+        valid = denom > 0
+        iou = jnp.where(valid, correct / jnp.maximum(denom, 1), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+        return (miou.astype(jnp.float32), wrong.astype(jnp.int32),
+                correct.astype(jnp.int32))
+
+    return tracer.trace_fn(fn, [input, label], name="mean_iou")
+
+
+def unique_with_counts(x, dtype="int32"):
+    """fluid.layers.unique_with_counts — ``(out, index, count)`` in the
+    v2.1 contract: ``out`` keeps FIRST-APPEARANCE order (not sorted; the
+    docs' example [2,3,3,1,5,3] -> [2,3,1,5]), ``index`` maps each input
+    element to its slot in ``out``, and index/count carry ``dtype``
+    (int32 by default), unlike the 2.x sorted ``T.unique``."""
+    from ...dygraph import tracer
+
+    def fn(a):
+        import jax.numpy as jnp
+
+        flat = a.reshape(-1)
+        u, first, inv, counts = jnp.unique(
+            flat, return_index=True, return_inverse=True, return_counts=True)
+        order = jnp.argsort(first)       # sorted-unique slot -> appearance
+        rank = jnp.argsort(order)        # appearance rank of each slot
+        return (u[order], rank[inv.reshape(-1)].astype(dtype),
+                counts[order].astype(dtype))
+
+    return tracer.trace_fn(fn, [x], name="unique_with_counts")
+
+
+def space_to_depth(x, blocksize, name=None):
+    """fluid.layers.space_to_depth (space_to_depth_op): NCHW blocks of
+    ``blocksize`` move into channels with (offset_h, offset_w, c) channel
+    ordering — out[:, (oh*bs + ow)*C + c, h, w]."""
+    from ...dygraph import tracer
+
+    bs = int(blocksize)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        assert h % bs == 0 and w % bs == 0, (
+            f"space_to_depth: spatial dims {(h, w)} must divide "
+            f"blocksize {bs}")
+        a = a.reshape(n, c, h // bs, bs, w // bs, bs)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * bs * bs, h // bs, w // bs)
+
+    return tracer.trace_fn(fn, [x], name="space_to_depth")
+
+
 # PS-era / LoD-runtime / long-deprecated names: informative raise with the
 # modern route (reference: fluid/layers/nn.py, sequence_lod.py, io.py)
 _PS_ERA = {
@@ -700,14 +824,12 @@ _PS_ERA = {
                     "paddle.multinomial"),
     "similarity_focus": ("a deprecated attention op", "explicit tensor ops"),
     "hash": ("a PS sparse-feature op", "python-side feature hashing"),
-    "grid_sampler": ("pending", "paddle.nn.functional.grid_sample"),
     "add_position_encoding": ("deprecated", "explicit position embeddings"),
     "merge_selected_rows": ("a SelectedRows runtime op",
                             "dense gradients (SelectedRows are dense here)"),
     "get_tensor_from_selected_rows": ("a SelectedRows runtime op",
                                       "the tensor itself"),
     "shuffle_channel": ("deprecated", "reshape+transpose"),
-    "temporal_shift": ("pending", "explicit slice+concat"),
     "psroi_pool": ("a niche detection op", "roi_align"),
     "prroi_pool": ("a niche detection op", "roi_align"),
     "fsp_matrix": ("a distillation helper", "explicit matmul over features"),
@@ -715,9 +837,6 @@ _PS_ERA = {
     "filter_by_instag": ("a PS instance-tag op", "python-side filtering"),
     "shard_index": ("a PS sharding op",
                     "mesh sharding (paddle.distributed)"),
-    "gather_tree": ("pending", "models.generation beam utilities"),
-    "space_to_depth": ("deprecated", "paddle.nn.functional.pixel_unshuffle"),
-    "affine_grid": ("pending", "paddle.nn.functional.affine_grid"),
     "affine_channel": ("deprecated", "scale+bias tensor ops"),
     "inplace_abn": ("a fused-CUDA ABN", "paddle.static.nn.batch_norm"),
     "pad_constant_like": ("deprecated", "paddle.nn.functional.pad"),
@@ -726,10 +845,6 @@ _PS_ERA = {
     "image_resize_short": ("deprecated", "paddle.vision.transforms.Resize"),
     "resize_linear": ("1-D resize", "paddle.nn.functional.interpolate"),
     "resize_trilinear": ("3-D resize", "paddle.nn.functional.interpolate"),
-    "mean_iou": ("pending", "paddle.metric + numpy"),
-    "multiplex": ("deprecated", "paddle.where / gather"),
-    "unique_with_counts": ("deprecated",
-                           "paddle.unique(return_counts=True)"),
     "deformable_roi_pooling": ("a niche detection op", "roi_align"),
     "bilinear_tensor_product": ("available via static.nn",
                                 "paddle.static.nn.bilinear_tensor_product"),

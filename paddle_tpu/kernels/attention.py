@@ -55,28 +55,32 @@ def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
 
     ``layout="bsnd"`` ([b, s, nh, d], the model-natural layout after a QKV
     projection) feeds the seq-major kernel specs directly — no materialized
-    transposes around the custom call (flash._fwd_call_smajor)."""
+    transposes around the custom call (flash._fwd_call_smajor).
+    ``layout="sbnd"`` ([s, b, nh, d]) is the end-to-end [S, B, H] activation
+    layout (GPTConfig.seq_major), likewise consumed in place."""
     from . import flash
     from ..framework import flags
 
-    s_axis = -3 if layout == "bsnd" else -2
+    s_axis = flash._layout_s_axis(layout, q.ndim)
     if (flags.flag("FLAGS_tpu_flash_attention")
             and flash.available() and q.shape[s_axis] >= 512
             and flash.supported(q, k, mask=mask, dropout_p=dropout_p,
                                 layout=layout)):
         return flash.flash_attention(q, k, v, causal=is_causal, scale=scale,
                                      layout=layout)
-    if layout == "bsnd":
+    if layout in ("bsnd", "sbnd"):
         if q.ndim != 4:
             raise ValueError(
-                f"layout='bsnd' expects [b, s, nh, d] (4-D), got {q.shape}")
+                f"layout={layout!r} expects 4-D q/k/v, got {q.shape}")
         # reference path works on [..., s, d]: transpose in/out (CPU tests;
         # perf path is the kernel above)
-        qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
-        out = _sdpa_reference(qt, kt, vt, mask=mask, scale=scale,
-                              is_causal=is_causal, dropout_p=dropout_p,
-                              rng=rng)
-        return jnp.swapaxes(out, 1, 2)
+        to_bnsd = (lambda a: jnp.transpose(a, (1, 2, 0, 3))) \
+            if layout == "sbnd" else (lambda a: jnp.swapaxes(a, 1, 2))
+        out = _sdpa_reference(to_bnsd(q), to_bnsd(k), to_bnsd(v), mask=mask,
+                              scale=scale, is_causal=is_causal,
+                              dropout_p=dropout_p, rng=rng)
+        return (jnp.transpose(out, (2, 0, 1, 3)) if layout == "sbnd"
+                else jnp.swapaxes(out, 1, 2))
     return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal,
                            dropout_p=dropout_p, rng=rng)
 

@@ -31,6 +31,15 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _x64_off():
+    """Context manager disabling x64 promotion while tracing the kernels —
+    ``jax.enable_x64`` was removed from the top-level namespace; the
+    supported spelling is ``jax.experimental.disable_x64()``."""
+    from jax.experimental import disable_x64
+
+    return disable_x64()
+
+
 def _backend_is_tpu() -> bool:
     try:
         dev = jax.devices()[0]
@@ -76,8 +85,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     nkb = s_len // block_k
     if causal:
         # q rows for this block end at (i+1)*bq - 1; k-blocks past that are
-        # fully masked — skip them entirely.
-        hi = jnp.minimum(((i + 1) * bq + block_k - 1) // block_k, nkb)
+        # fully masked — skip them entirely.  (i32 constants throughout: in
+        # interpret mode the body is evaluated under the caller's dtype
+        # config, where x64 promotion breaks the i32 index math.)
+        hi = jnp.minimum(((i + 1) * jnp.int32(bq) + jnp.int32(block_k - 1))
+                         // jnp.int32(block_k), jnp.int32(nkb))
     else:
         hi = nkb
 
@@ -85,11 +97,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         m, l, acc = carry
         k = k_ref[pl.ds(j * block_k, block_k), :]
         v = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
             qi = i * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kj = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(qi >= kj, s, _NEG_INF)
+            s = jnp.where(qi >= kj, s, jnp.float32(_NEG_INF))
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -98,8 +111,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
+    # pin the bounds to i32: in interpret mode the body is evaluated under
+    # the CALLER's dtype config, where jax_enable_x64 would promote the
+    # python-int lower bound to i64 against an i32 upper bound
+    m, l, acc = lax.fori_loop(jnp.int32(0), jnp.asarray(hi, jnp.int32),
+                              body, (m0, l0, acc0))
+    l = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
     lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
 
@@ -110,7 +127,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
     # Mosaic has no 64-bit types; trace the kernel with x64 promotion off so
     # the framework-global jax_enable_x64 (int64 id parity) can't leak
     # int64/f64 scalars into the lowering.
-    with jax.enable_x64(False):
+    with _x64_off():
         out, lse = _fwd_call(q3, k3, v3, scale, causal, block_q, block_k,
                              interpret, bh, s_len, d, nq)
     return out, lse
@@ -157,26 +174,29 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     nkb = s_len // block_k
     if causal:
-        hi = jnp.minimum(((i + 1) * bq + block_k - 1) // block_k, nkb)
+        hi = jnp.minimum(((i + 1) * jnp.int32(bq) + jnp.int32(block_k - 1))
+                         // jnp.int32(block_k), jnp.int32(nkb))
     else:
         hi = nkb
 
     def body(j, dq):
         k = k_ref[pl.ds(j * block_k, block_k), :]
         v = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
             qi = i * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kj = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(qi >= kj, s, _NEG_INF)
+            s = jnp.where(qi >= kj, s, jnp.float32(_NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v.astype(jnp.float32).T,
                      preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
         return dq + jnp.dot(ds.astype(k.dtype), k,
                             preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq = lax.fori_loop(jnp.int32(0), jnp.asarray(hi, jnp.int32), body,
+                       jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
@@ -190,7 +210,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     j = pl.program_id(grid_axis)
 
     nqb = s_len // block_q
-    lo = (j * bk) // block_q if causal else 0
+    lo = (j * jnp.int32(bk)) // jnp.int32(block_q) if causal else 0
 
     def body(i, carry):
         dk, dv = carry
@@ -198,31 +218,33 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, pl.ds(i * block_q, block_q)]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
             qi = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             kj = j * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(qi >= kj, s, _NEG_INF)
+            s = jnp.where(qi >= kj, s, jnp.float32(_NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dv = dv + jnp.dot(p.T.astype(do.dtype), do,
                           preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.astype(jnp.float32).T,
                      preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
         dk = dk + jnp.dot(ds.T.astype(q.dtype), q,
                           preferred_element_type=jnp.float32)
         return dk, dv
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(lo, nqb, body, (dk0, dv0))
+    dk, dv = lax.fori_loop(jnp.asarray(lo, jnp.int32),
+                           jnp.asarray(nqb, jnp.int32), body, (dk0, dv0))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
                interpret):
-    with jax.enable_x64(False):
+    with _x64_off():
         return _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q,
                          block_k, interpret)
 
@@ -293,12 +315,19 @@ def _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
 # with a computed head index.
 
 
-def _smajor_specs(b, s_len, nh, d, block, what):
+def _smajor_specs(b, s_len, nh, d, block, what, seq_first=False):
     """BlockSpecs for [b, s, nh*d] arrays (one head-column slab per
-    program) and (b*nh, 1, s) lse/delta rows; grid = (b, nh, blocks)."""
+    program) and (b*nh, 1, s) lse/delta rows; grid = (b, nh, blocks).
+    ``seq_first=True`` selects [s, b, nh*d] arrays instead — the model's
+    end-to-end [S, B, H] activation layout — with the same squeezed
+    (block, d) kernel blocks, so the kernel bodies are shared."""
     if what == "tile":
+        if seq_first:
+            return pl.BlockSpec((block, None, d), lambda b_, h, i: (i, b_, h))
         return pl.BlockSpec((None, block, d), lambda b_, h, i: (b_, i, h))
     if what == "full":
+        if seq_first:
+            return pl.BlockSpec((s_len, None, d), lambda b_, h, i: (0, b_, h))
         return pl.BlockSpec((None, s_len, d), lambda b_, h, i: (b_, 0, h))
     if what == "row":
         return pl.BlockSpec((None, 1, block),
@@ -310,26 +339,36 @@ def _smajor_specs(b, s_len, nh, d, block, what):
 
 
 def _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q, block_k,
-                     interpret):
-    b, s_len, H = q3.shape
+                     interpret, seq_first=False):
+    if seq_first:
+        s_len, b, H = q3.shape
+        act_shape = (s_len, b, H)
+    else:
+        b, s_len, H = q3.shape
+        act_shape = (b, s_len, H)
     d = H // nh
     nq = s_len // block_q
-    with jax.enable_x64(False):
+
+    def sp(what, block):
+        return _smajor_specs(b, s_len, nh, d, block, what,
+                             seq_first=seq_first)
+
+    with _x64_off():
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, scale=scale, causal=causal,
                               block_k=block_k, grid_axis=2),
             grid=(b, nh, nq),
             in_specs=[
-                _smajor_specs(b, s_len, nh, d, block_q, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_q, "full"),
-                _smajor_specs(b, s_len, nh, d, block_q, "full"),
+                sp("tile", block_q),
+                sp("full", block_q),
+                sp("full", block_q),
             ],
             out_specs=[
-                _smajor_specs(b, s_len, nh, d, block_q, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_q, "row"),
+                sp("tile", block_q),
+                sp("row", block_q),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((b, s_len, H), q3.dtype),
+                jax.ShapeDtypeStruct(act_shape, q3.dtype),
                 jax.ShapeDtypeStruct((b * nh, 1, s_len), jnp.float32),
             ],
             interpret=interpret,
@@ -338,14 +377,26 @@ def _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q, block_k,
 
 
 def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
-                     block_k, interpret):
-    b, s_len, H = q3.shape
+                     block_k, interpret, seq_first=False):
+    if seq_first:
+        s_len, b, H = q3.shape
+        act_shape = (s_len, b, H)
+    else:
+        b, s_len, H = q3.shape
+        act_shape = (b, s_len, H)
     d = H // nh
-    with jax.enable_x64(False):
+
+    def sp(what, block):
+        return _smajor_specs(b, s_len, nh, d, block, what,
+                             seq_first=seq_first)
+
+    with _x64_off():
+        dsum = jnp.sum((do.astype(jnp.float32) * out.astype(jnp.float32))
+                       .reshape(act_shape[:2] + (nh, d)), axis=-1)
+        # rows of the (b*nh, 1, s) delta: (b, nh, s) from either layout
         delta = jnp.transpose(
-            jnp.sum((do.astype(jnp.float32) * out.astype(jnp.float32))
-                    .reshape(b, s_len, nh, d), axis=-1),
-            (0, 2, 1)).reshape(b * nh, 1, s_len)
+            dsum, (1, 2, 0) if seq_first else (0, 2, 1)
+        ).reshape(b * nh, 1, s_len)
 
         nq = s_len // block_q
         dq = pl.pallas_call(
@@ -353,15 +404,15 @@ def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
                               block_k=block_k, grid_axis=2),
             grid=(b, nh, nq),
             in_specs=[
-                _smajor_specs(b, s_len, nh, d, block_q, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_q, "full"),
-                _smajor_specs(b, s_len, nh, d, block_q, "full"),
-                _smajor_specs(b, s_len, nh, d, block_q, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_q, "row"),
-                _smajor_specs(b, s_len, nh, d, block_q, "row"),
+                sp("tile", block_q),
+                sp("full", block_q),
+                sp("full", block_q),
+                sp("tile", block_q),
+                sp("row", block_q),
+                sp("row", block_q),
             ],
-            out_specs=_smajor_specs(b, s_len, nh, d, block_q, "tile"),
-            out_shape=jax.ShapeDtypeStruct((b, s_len, H), q3.dtype),
+            out_specs=sp("tile", block_q),
+            out_shape=jax.ShapeDtypeStruct(act_shape, q3.dtype),
             interpret=interpret,
         )(q3, k3, v3, do, lse, delta)
 
@@ -371,44 +422,47 @@ def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
                               block_q=block_q, grid_axis=2),
             grid=(b, nh, nk),
             in_specs=[
-                _smajor_specs(b, s_len, nh, d, block_k, "full"),
-                _smajor_specs(b, s_len, nh, d, block_k, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_k, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_k, "full"),
-                _smajor_specs(b, s_len, nh, d, block_k, "row_full"),
-                _smajor_specs(b, s_len, nh, d, block_k, "row_full"),
+                sp("full", block_k),
+                sp("tile", block_k),
+                sp("tile", block_k),
+                sp("full", block_k),
+                sp("row_full", block_k),
+                sp("row_full", block_k),
             ],
             out_specs=[
-                _smajor_specs(b, s_len, nh, d, block_k, "tile"),
-                _smajor_specs(b, s_len, nh, d, block_k, "tile"),
+                sp("tile", block_k),
+                sp("tile", block_k),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((b, s_len, H), k3.dtype),
-                jax.ShapeDtypeStruct((b, s_len, H), v3.dtype),
+                jax.ShapeDtypeStruct(act_shape, k3.dtype),
+                jax.ShapeDtypeStruct(act_shape, v3.dtype),
             ],
             interpret=interpret,
         )(q3, k3, v3, do, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
-def _flash_smajor(nh, causal, scale, block_q, block_k, interpret, q3, k3, v3):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6))
+def _flash_smajor(nh, causal, scale, block_q, block_k, interpret, seq_first,
+                  q3, k3, v3):
     out, _ = _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q,
-                              block_k, interpret)
+                              block_k, interpret, seq_first=seq_first)
     return out
 
 
 def _flash_smajor_fwd(nh, causal, scale, block_q, block_k, interpret,
-                      q3, k3, v3):
+                      seq_first, q3, k3, v3):
     out, lse = _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q,
-                                block_k, interpret)
+                                block_k, interpret, seq_first=seq_first)
     return out, (q3, k3, v3, out, lse)
 
 
-def _flash_smajor_bwd(nh, causal, scale, block_q, block_k, interpret, res, do):
+def _flash_smajor_bwd(nh, causal, scale, block_q, block_k, interpret,
+                      seq_first, res, do):
     q3, k3, v3, out, lse = res
     return _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret,
+                            seq_first=seq_first)
 
 
 _flash_smajor.defvjp(_flash_smajor_fwd, _flash_smajor_bwd)
@@ -440,19 +494,29 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _layout_s_axis(layout, ndim=4):
+    if layout == "bsnd":
+        return -3
+    if layout == "sbnd":
+        return -ndim  # seq leads: [s, b, nh, d]
+    return -2
+
+
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
                     block_q=None, block_k=None, layout="bnsd"):
     """Flash attention.  ``layout="bnsd"``: [..., seq, head_dim] (q/k same
     length); ``layout="bsnd"``: [batch, seq, heads, head_dim] — consumed
     seq-major IN PLACE, so the caller pays no materialized [b,nh,s,d]
-    transposes around the custom call.  Raises ValueError on unsupported
-    shapes — callers should gate on :func:`supported` first (the sdpa
-    dispatcher does)."""
+    transposes around the custom call; ``layout="sbnd"``: [seq, batch,
+    heads, head_dim] — the model's end-to-end [S, B, H] activation layout
+    (GPTConfig.seq_major), also consumed in place.  Raises ValueError on
+    unsupported shapes — callers should gate on :func:`supported` first
+    (the sdpa dispatcher does)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = not _backend_is_tpu()
-    s_axis = -3 if layout == "bsnd" else -2
+    s_axis = _layout_s_axis(layout, q.ndim)
     s_len = q.shape[s_axis]
     bq = block_q or _pick_block(s_len)
     bk = block_k or _pick_block(s_len)
@@ -460,15 +524,20 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
         raise ValueError(
             f"flash_attention: unsupported seq len {s_len} (needs a power-of-"
             f"two-ish divisor >= 8) or cross-attention q/k lengths")
-    if layout == "bsnd":
-        assert q.ndim == 4, "bsnd layout expects [b, s, nh, d]"
-        b, _, nh, d = q.shape
+    if layout in ("bsnd", "sbnd"):
+        assert q.ndim == 4, f"{layout} layout expects 4-D q/k/v"
+        seq_first = layout == "sbnd"
+        if seq_first:
+            _, b, nh, d = q.shape
+            flat = (s_len, b, nh * d)
+        else:
+            b, _, nh, d = q.shape
+            flat = (b, s_len, nh * d)
         out = _flash_smajor(int(nh), causal, float(scale), int(bq), int(bk),
-                            bool(interpret),
-                            q.reshape(b, s_len, nh * d),
-                            k.reshape(b, s_len, nh * d),
-                            v.reshape(b, s_len, nh * d))
-        return out.reshape(b, s_len, nh, d)
+                            bool(interpret), seq_first,
+                            q.reshape(flat), k.reshape(flat),
+                            v.reshape(flat))
+        return out.reshape(q.shape)
     lead = q.shape[:-2]
     d = q.shape[-1]
     q3 = q.reshape((-1, s_len, d))
@@ -483,8 +552,8 @@ def supported(q, k, mask=None, dropout_p=0.0, layout="bnsd") -> bool:
     """Shape/feature gate used by the sdpa dispatcher."""
     if mask is not None or dropout_p != 0.0:
         return False
-    s_axis = -3 if layout == "bsnd" else -2
-    if layout == "bsnd" and q.ndim != 4:
+    s_axis = _layout_s_axis(layout, q.ndim)
+    if layout in ("bsnd", "sbnd") and q.ndim != 4:
         return False
     if q.ndim < 3 or q.shape[s_axis] != k.shape[s_axis]:
         return False

@@ -48,26 +48,44 @@ def _ln(x, g, b, eps):
     return (x - mu) / jnp.sqrt(var + eps) * g + b
 
 
-def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps):
-    """One decoder block over ``x`` (B, T, h) with cache write at ``pos``.
+def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
+    """One decoder block over ``x`` with cache write at ``pos``.
+
+    ``x`` is (B, T, h) batch-major or (T, B, h) when ``seq_major`` — the
+    model's [S, B, H] activation layout (GPTConfig.seq_major).  The KV cache
+    keeps its (B, H, S, D) layout in both modes; the attention einsums
+    consume/produce the seq-major activations in place.
 
     Works for prefill (T = prompt len, pos = 0) and decode (T = 1,
     pos = current length).  Returns (y, k_cache, v_cache)."""
-    b, t, h = x.shape
+    if seq_major:
+        t, b, h = x.shape
+    else:
+        b, t, h = x.shape
     hd = h // n_heads
     hx = _ln(x, p["ln1_g"], p["ln1_b"], eps)
     qkv = hx @ p["qkv_w"] + p["qkv_b"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
-    def heads(z):  # (B, T, h) -> (B, H, T, hd)
-        return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
+    if seq_major:
+        def heads(z):  # (T, B, h) -> (T, B, H, hd)
+            return z.reshape(t, b, n_heads, hd)
 
-    q, k, v = heads(q), heads(k), heads(v)
-    k_cache = lax.dynamic_update_slice(k_cache, k, (0, 0, pos, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v, (0, 0, pos, 0))
+        q, k, v = heads(q), heads(k), heads(v)
+        # cache blocks are tiny in decode (T=1): einsum to the cache layout
+        k_blk = jnp.einsum("tbhd->bhtd", k)
+        v_blk = jnp.einsum("tbhd->bhtd", v)
+    else:
+        def heads(z):  # (B, T, h) -> (B, H, T, hd)
+            return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        k_blk, v_blk = k, v
+    k_cache = lax.dynamic_update_slice(k_cache, k_blk, (0, 0, pos, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v_blk, (0, 0, pos, 0))
     s_max = k_cache.shape[2]
-    scores = jnp.einsum("bhtd,bhsd->bhts", q, k_cache,
-                        preferred_element_type=jnp.float32)
+    scores = jnp.einsum("tbhd,bhsd->bhts" if seq_major else "bhtd,bhsd->bhts",
+                        q, k_cache, preferred_element_type=jnp.float32)
     scores = scores / np.sqrt(hd).astype(np.float32)
     # causal + cache-validity mask over global positions
     q_pos = pos + jnp.arange(t)[:, None]
@@ -75,8 +93,11 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps):
     mask = kv_pos <= q_pos
     scores = jnp.where(mask[None, None], scores, -1e30)
     att = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-    out = jnp.einsum("bhts,bhsd->bhtd", att, v_cache)
-    out = out.transpose(0, 2, 1, 3).reshape(b, t, h)
+    if seq_major:
+        out = jnp.einsum("bhts,bhsd->tbhd", att, v_cache).reshape(t, b, h)
+    else:
+        out = jnp.einsum("bhts,bhsd->bhtd", att, v_cache)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, h)
     x = x + out @ p["proj_w"] + p["proj_b"]
     hx = _ln(x, p["ln2_g"], p["ln2_b"], eps)
     x = x + jax.nn.gelu(hx @ p["fc1_w"] + p["fc1_b"],
@@ -96,6 +117,7 @@ def _decoder_setup(model, what="KV-cache decode"):
     gpt = model.gpt
     eps = cfg.layer_norm_eps
     n_heads = cfg.num_heads
+    seq_major = bool(getattr(cfg, "seq_major", False))
     params = {
         "wte": gpt.embeddings.word_embeddings.weight._array,
         "wpe": gpt.embeddings.position_embeddings.weight._array,
@@ -110,14 +132,23 @@ def _decoder_setup(model, what="KV-cache decode"):
 
         def run(tokens, pos, kc, vc):
             t = tokens.shape[1]
-            x = p["wte"][tokens] + p["wpe"][pos + jnp.arange(t)]
+            pe = p["wpe"][pos + jnp.arange(t)]
+            if seq_major:
+                # [T, B, h] through the blocks (cfg.seq_major decode)
+                x = p["wte"][tokens.T] + pe[:, None, :]
+            else:
+                x = p["wte"][tokens] + pe
             new_k, new_v = [], []
             for li, bp in enumerate(p["blocks"]):
                 x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
-                                       n_heads, eps)
+                                       n_heads, eps, seq_major=seq_major)
                 new_k.append(k1)
                 new_v.append(v1)
-            return logits_from(x), jnp.stack(new_k), jnp.stack(new_v)
+            logits = logits_from(x)
+            if seq_major:
+                # callers index logits[:, -1]: keep the (B, T, V) contract
+                logits = jnp.swapaxes(logits, 0, 1)
+            return logits, jnp.stack(new_k), jnp.stack(new_v)
 
         return run
 

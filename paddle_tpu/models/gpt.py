@@ -39,6 +39,12 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     use_parallel: bool = False  # TP layers over the 'mp' axis
+    # seq_major: thread a [S, B, H] activation layout from the embedding to
+    # the logits so the flash kernel's seq-major entry (layout="sbnd",
+    # kernels/flash._fwd_call_smajor) sees the model-natural layout with ZERO
+    # transposes at either end.  Batch-major stays the default until the
+    # seq-major flagship point is benched (bench.py flagship_seq_major).
+    seq_major: bool = False
 
     def __post_init__(self):
         if self.ffn_hidden is None:
@@ -74,6 +80,7 @@ class GPTAttention(nn.Layer):
         self.num_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.dropout = cfg.dropout
+        self.seq_major = cfg.seq_major
         init = nn.initializer.Normal(0.0, cfg.initializer_range)
         wa = nn.ParamAttr(initializer=init)
         if cfg.use_parallel:
@@ -90,6 +97,21 @@ class GPTAttention(nn.Layer):
             self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size, weight_attr=wa)
 
     def forward(self, x):
+        if self.seq_major:
+            # [S, B, H] in, [S, B, H] out — q/k/v reach the kernel through
+            # reshapes and last-dim slices only (NO transposes; the sbnd
+            # kernel entry consumes the layout in place)
+            s, b, h = x.shape
+            qkv = self.qkv(x)
+            local_h = qkv.shape[-1] // 3
+            nh = local_h // self.head_dim
+            q, k, v = T.split(qkv, 3, axis=-1)
+            shp = [s, b, nh, self.head_dim]
+            out = F.scaled_dot_product_attention(
+                T.reshape(q, shp), T.reshape(k, shp), T.reshape(v, shp),
+                is_causal=True, dropout_p=self.dropout,
+                training=self.training, layout="sbnd")
+            return self.proj(T.reshape(out, [s, b, local_h]))
         b, s, h = x.shape
         qkv = self.qkv(x)
         local_h = qkv.shape[-1] // 3
@@ -98,7 +120,9 @@ class GPTAttention(nn.Layer):
         # flash call cost ~34ms/step, but the seq-major kernel variant
         # (layout="bsnd", kernels/flash._fwd_call_smajor) loses MORE to
         # strided K/V DMA (55.0% vs 57.1% MFU) — contiguous (bh, s, d)
-        # tiles + XLA transposes win, so this stays bnsd
+        # tiles + XLA transposes win, so batch-major stays bnsd; the
+        # END-TO-END seq-major layout is cfg.seq_major (the [S, B, H] branch
+        # above), which removes the transposes without restriding K/V.
         qkv = T.reshape(qkv, [b, s, 3, nh, self.head_dim])
         qkv = T.transpose(qkv, [2, 0, 3, 1, 4])  # [3, b, nh, s, hd]
         q, k, v = qkv[0], qkv[1], qkv[2]
@@ -163,11 +187,19 @@ class GPTEmbeddings(nn.Layer):
             cfg.max_seq_len, cfg.hidden_size,
             weight_attr=nn.ParamAttr(initializer=init))
         self.dropout = nn.Dropout(cfg.dropout)
+        self.seq_major = cfg.seq_major
 
     def forward(self, ids):
         b, s = ids.shape
         pos = T.arange(0, s, 1, dtype="int64")
-        x = self.word_embeddings(ids) + self.position_embeddings(pos)
+        pe = self.position_embeddings(pos)
+        if self.seq_major:
+            # transpose the int32 [B, S] ids ONCE at the entry; everything
+            # downstream (blocks, LN, logits) stays [S, B, H]
+            x = self.word_embeddings(T.transpose(ids, [1, 0])) \
+                + T.unsqueeze(pe, [1])
+        else:
+            x = self.word_embeddings(ids) + pe
         return self.dropout(x)
 
 
@@ -201,9 +233,21 @@ class GPTForPretraining(nn.Layer):
 
 
 class GPTPretrainingCriterion(nn.Layer):
-    """Next-token CE (vocab-parallel when logits are mp-sharded)."""
+    """Next-token CE (vocab-parallel when logits are mp-sharded).
+
+    ``seq_major``: logits arrive [S, B, V] while labels stay in the data
+    layout [B, S] — the cheap int label transpose happens HERE so the big
+    logits tensor never changes layout."""
+
+    def __init__(self, seq_major: bool = False):
+        super().__init__()
+        self.seq_major = seq_major
 
     def forward(self, logits, labels, loss_mask=None):
+        if self.seq_major:
+            labels = T.transpose(labels, [1, 0])
+            if loss_mask is not None:
+                loss_mask = T.transpose(loss_mask, [1, 0])
         loss = F.softmax_with_cross_entropy(logits, T.unsqueeze(labels, [-1]))
         loss = T.squeeze(loss, [-1])
         if loss_mask is not None:
@@ -245,7 +289,8 @@ def GPTForPretrainingPipe(cfg: GPTConfig, num_stages: Optional[int] = None,
     ]
     return PipelineLayer(
         layers=descs, num_stages=num_stages,
-        loss_fn=GPTPretrainingCriterion(), **kw)
+        loss_fn=GPTPretrainingCriterion(seq_major=cfg.seq_major),
+        seq_major=cfg.seq_major, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +357,8 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
     mesh = mesh_mod.get_mesh()
     pp = mesh_mod.axis_size("pp")
     shd = mesh_mod.axis_size("sharding")
+    # seq-major activations put batch on dim 1; ids/labels stay [B, S]
+    seq_major = bool(getattr(model.cfg, "seq_major", False))
     if sharding_stage is None:
         # honor DistributedStrategy.sharding_configs["stage"] when fleet is up
         try:
@@ -402,8 +449,8 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
 
     def _constrain_dp(x):
         if mesh is not None and mesh_mod.axis_size(dp_axis) > 1:
-            return lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(dp_axis)))
+            spec = P(None, dp_axis) if seq_major else P(dp_axis)
+            return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
         return x
 
     def fwd(params_tree, ids):
@@ -489,10 +536,13 @@ def build_functional_train_step(model: GPTForPretraining, lr: float = 1e-4,
 
     def loss_fn(params_tree, ids, labels):
         x, w = fwd(params_tree, ids)
-        b, s, h = x.shape
+        if seq_major:
+            # x is [S, B, H]; align the (cheap, int) labels to it
+            labels = jnp.swapaxes(labels, 0, 1)
+        d0, d1, h = x.shape
         if ce_chunk_rows:
-            return _chunked_softmax_xent(x.reshape(b * s, h), w,
-                                         labels.reshape(b * s),
+            return _chunked_softmax_xent(x.reshape(d0 * d1, h), w,
+                                         labels.reshape(d0 * d1),
                                          chunk_rows=ce_chunk_rows)
         logits = jnp.matmul(x, w.T)
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)

@@ -623,18 +623,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     )
 
 
-def ring_attention(query, key, value, axis="mp", is_causal=False, name=None):
+def ring_attention(query, key, value, axis="mp", is_causal=False, name=None,
+                   layout="bnsd"):
     """Sequence-parallel attention over a mesh axis (kernels/ring.py):
     Q/K/V sequence-sharded, K/V streamed around the ICI ring via ppermute.
     Beyond-parity long-context path (SURVEY §5); inputs/outputs are
-    (B, H, S, D) Tensors, output sequence-sharded like the inputs.
+    (B, H, S, D) Tensors — or (S, B, NH, D) with ``layout="sbnd"``, the
+    model's seq-major activation layout (GPTConfig.seq_major) — output
+    sequence-sharded like the inputs.
     Differentiable (vjp through the shard_map ring)."""
     from ...kernels.ring import ring_attention as _ring
 
     from ...dygraph import tracer
 
     def fn(q, k, v):
-        return _ring(q, k, v, axis=axis, causal=is_causal)
+        return _ring(q, k, v, axis=axis, causal=is_causal, layout=layout)
 
     return tracer.trace_fn(fn, [query, key, value], name="ring_attention")
 

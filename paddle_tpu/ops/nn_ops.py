@@ -219,7 +219,10 @@ def max_pool2d_with_index_kernel(ins, attrs):
                   else [(p[0], p[1]), (p[2], p[3])])
         if attrs.get("ceil_mode", False):
             sp_pad = _ceil_extend(sp_pad, x.shape[2:], ksize, strides)
-    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) \
+    # finite min, NOT -inf: conv_general_dilated_patches extracts patches
+    # with 0/1 kernels, and -inf * 0 = NaN poisons every padded window
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype) \
+        if jnp.issubdtype(x.dtype, jnp.floating) \
         else jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype)
     xp = jnp.pad(x, [(0, 0), (0, 0)] + list(sp_pad), constant_values=neg)
     patches = jax.lax.conv_general_dilated_patches(
@@ -234,6 +237,12 @@ def max_pool2d_with_index_kernel(ins, attrs):
     ox = jnp.arange(ohw[1]).reshape(1, 1, 1, -1)
     gh = oy * strides[0] - sp_pad[0][0] + kh
     gw = ox * strides[1] - sp_pad[1][0] + kw
+    # argmax over padded/ceil-extended windows can land on a padding cell
+    # (all -inf ties resolve to window position 0): clamp to the valid
+    # input range so Mask can never go negative or past h*w — unpoolers
+    # scatter by this index
+    gh = jnp.clip(gh, 0, h - 1)
+    gw = jnp.clip(gw, 0, w - 1)
     return {"Out": out, "Mask": (gh * w + gw).astype(jnp.int32)}
 
 

@@ -1,0 +1,44 @@
+"""bench.py extras must be runnable on CPU: the seq-major flagship config
+(tiny-sized here), the eager-vs-jit dispatch-latency microbench, and the
+DataLoader spawn+shm-ring throughput microbench (ISSUE r06 acceptance)."""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_seq_major_bench_config_runs():
+    from paddle_tpu.models import GPTConfig
+
+    res = bench._run(
+        GPTConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=2,
+                  max_seq_len=64, dropout=0.0, seq_major=True),
+        batch=2, seq=32, steps=2, peak_flops=1e12,
+        dtype="float32", remat=False, ce_rows=0)
+    assert res["tokens_per_sec"] > 0
+    assert np.isfinite(res["loss"])
+    assert res["config"]["seq"] == 32
+
+
+def test_dispatch_latency_bench_emits_numbers():
+    res = bench._dispatch_latency_bench(n_ops=20, size=64, repeats=3)
+    assert res["eager_us_per_op"] > 0
+    assert res["jit_us_per_op"] > 0
+    assert np.isfinite(res["dispatch_overhead_x"])
+    assert res["config"]["n_ops"] == 40
+
+
+def test_dataloader_bench_emits_numbers():
+    res = bench._dataloader_bench(n=16, shape=(32, 32), batch_size=4,
+                                  num_workers=2)
+    assert res["single_process"]["batches_per_sec"] > 0
+    assert res["spawn_shm_ring"]["batches_per_sec"] > 0
+    assert res["spawn_shm_ring"]["num_workers"] == 2
+    assert res["single_process"]["mb_per_sec"] > 0

@@ -1,0 +1,330 @@
+"""Round-6 satellite fixes.
+
+Covers: the 8 fluid.layers names wired to their 2.x implementations
+(grid_sampler, temporal_shift, affine_grid, gather_tree, mean_iou,
+multiplex, unique_with_counts, space_to_depth), the
+sigmoid_cross_entropy_with_logits ignore_index/normalize and smooth_l1
+sigma^2 semantics, the max_pool2d argmax clamp, HDFSClient binary-safe
+cat + atomic -put -f upload, and Xavier/MSRA isinstance compatibility.
+"""
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import tensor_api as T
+from paddle_tpu.fluid import layers as L
+
+
+# ---------------------------------------------------------------------------
+# the 8 wires (v2.1 arg order, numeric parity vs numpy references)
+# ---------------------------------------------------------------------------
+
+
+def test_grid_sampler_matches_functional():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    grid = (rng.rand(2, 4, 4, 2) * 2 - 1).astype("float32")
+    out = L.grid_sampler(paddle.to_tensor(x), paddle.to_tensor(grid))
+    ref = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode="bilinear", padding_mode="zeros",
+                        align_corners=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+
+def test_temporal_shift_channels_move_in_time():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 8, 2, 2).astype("float32")  # (N*T, C, H, W), T=2
+    out = L.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                           shift_ratio=0.25).numpy()
+    xr = x.reshape(2, 2, 8, 2, 2)
+    ref = np.zeros_like(xr)
+    ref[:, :-1, :2] = xr[:, 1:, :2]      # shift-forward channels
+    ref[:, 1:, 2:4] = xr[:, :-1, 2:4]    # shift-back channels
+    ref[:, :, 4:] = xr[:, :, 4:]         # untouched remainder
+    np.testing.assert_allclose(out, ref.reshape(4, 8, 2, 2), rtol=1e-6)
+
+
+def test_affine_grid_identity_theta():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32"),
+                    (2, 1, 1))
+    grid = L.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 4]).numpy()
+    xs = np.linspace(-1, 1, 4, dtype="float32")
+    np.testing.assert_allclose(grid[0, 0, :, 0], xs, atol=1e-6)
+    np.testing.assert_allclose(grid[0, :, 0, 1], xs, atol=1e-6)
+
+
+def test_gather_tree_backtracks_parents():
+    ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], "int64")  # (T, B=1, K=2)
+    parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], "int64")
+    out = L.gather_tree(paddle.to_tensor(ids),
+                        paddle.to_tensor(parents)).numpy()
+    # beam 0 at t=2 came from parent 1 at t=1, which came from parent 0
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 4])
+
+
+def test_mean_iou_counts_and_mean():
+    pred = paddle.to_tensor(np.array([0, 1, 2, 2, 1], "int64"))
+    lab = paddle.to_tensor(np.array([0, 1, 1, 2, 2], "int64"))
+    miou, wrong, correct = L.mean_iou(pred, lab, 3)
+    np.testing.assert_array_equal(correct.numpy(), [1, 1, 1])
+    # each mismatch increments BOTH its pred and label class counters
+    np.testing.assert_array_equal(wrong.numpy(), [0, 2, 2])
+    np.testing.assert_allclose(float(miou.numpy()),
+                               (1.0 + 1 / 3 + 1 / 3) / 3, rtol=1e-6)
+
+
+def test_multiplex_rows_by_index():
+    a = np.arange(6, dtype="float32").reshape(3, 2)
+    b = a + 100
+    idx = np.array([[1], [0], [1]], "int64")
+    out = L.multiplex([paddle.to_tensor(a), paddle.to_tensor(b)],
+                      paddle.to_tensor(idx)).numpy()
+    np.testing.assert_allclose(out, np.stack([b[0], a[1], b[2]]))
+
+
+def test_unique_with_counts_v21_contract():
+    x = np.array([2, 3, 3, 1, 5, 3], "int64")
+    out, index, count = L.unique_with_counts(paddle.to_tensor(x))
+    o, i, c = out.numpy(), index.numpy(), count.numpy()
+    # the fluid docs' own example: FIRST-APPEARANCE order, int32 aux dtype
+    np.testing.assert_array_equal(o, [2, 3, 1, 5])
+    np.testing.assert_array_equal(i, [0, 1, 1, 2, 3, 1])
+    np.testing.assert_array_equal(c, [1, 3, 1, 1])
+    assert i.dtype == np.int32 and c.dtype == np.int32
+    np.testing.assert_array_equal(o[i], x)  # inverse map reconstructs x
+    _, i64, _ = L.unique_with_counts(paddle.to_tensor(x), dtype="int64")
+    assert i64.numpy().dtype == np.int64
+
+
+def test_space_to_depth_channel_order():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = L.space_to_depth(paddle.to_tensor(x), 2).numpy()
+    assert out.shape == (1, 4, 2, 2)
+    # out channel = (offset_h*bs + offset_w)*C + c
+    np.testing.assert_allclose(out[0, :, 0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(out[0, :, 1, 1], [10, 11, 14, 15])
+    # inverse through the 2.x pixel-shuffle-style reshape
+    inv = out.reshape(1, 2, 2, 1, 2, 2).transpose(0, 3, 4, 1, 5, 2)
+    np.testing.assert_allclose(inv.reshape(1, 1, 4, 4), x)
+
+
+# ---------------------------------------------------------------------------
+# loss semantics fixes
+# ---------------------------------------------------------------------------
+
+
+def test_sigmoid_ce_ignore_index_and_normalize():
+    x = np.array([[0.5, -1.0, 2.0], [1.5, 0.0, -0.5]], "float32")
+    lab = np.array([[1.0, -100.0, 0.0], [-100.0, 1.0, -100.0]], "float32")
+    out = L.sigmoid_cross_entropy_with_logits(
+        paddle.to_tensor(x), paddle.to_tensor(lab)).numpy()
+    ref = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+    keep = lab != -100.0
+    np.testing.assert_allclose(out, np.where(keep, ref, 0.0), rtol=1e-5)
+
+    norm = L.sigmoid_cross_entropy_with_logits(
+        paddle.to_tensor(x), paddle.to_tensor(lab), normalize=True).numpy()
+    np.testing.assert_allclose(norm, np.where(keep, ref, 0.0) / keep.sum(),
+                               rtol=1e-5)
+
+    # custom ignore_index
+    out2 = L.sigmoid_cross_entropy_with_logits(
+        paddle.to_tensor(x), paddle.to_tensor(lab), ignore_index=-1).numpy()
+    np.testing.assert_allclose(out2, np.maximum(x, 0) - x * lab
+                               + np.log1p(np.exp(-np.abs(x))), rtol=1e-5)
+
+
+def test_smooth_l1_sigma_scaling_and_sum():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    sigma = 3.0
+    out = L.smooth_l1(paddle.to_tensor(x), paddle.to_tensor(y),
+                      sigma=sigma).numpy()
+    assert out.shape == (3, 1)
+    s2 = sigma * sigma
+    d = x - y
+    el = np.where(np.abs(d) < 1.0 / s2, 0.5 * s2 * d * d,
+                  np.abs(d) - 0.5 / s2)
+    np.testing.assert_allclose(out[:, 0], el.sum(axis=1), rtol=1e-5)
+
+    iw = rng.rand(3, 4).astype("float32")
+    ow = rng.rand(3, 4).astype("float32")
+    out_w = L.smooth_l1(paddle.to_tensor(x), paddle.to_tensor(y),
+                        inside_weight=paddle.to_tensor(iw),
+                        outside_weight=paddle.to_tensor(ow),
+                        sigma=sigma).numpy()
+    dw = (x - y) * iw
+    elw = np.where(np.abs(dw) < 1.0 / s2, 0.5 * s2 * dw * dw,
+                   np.abs(dw) - 0.5 / s2) * ow
+    np.testing.assert_allclose(out_w[:, 0], elw.sum(axis=1), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pool argmax clamp
+# ---------------------------------------------------------------------------
+
+
+def test_max_pool_mask_stays_in_range_with_padding():
+    rng = np.random.RandomState(0)
+    x = -np.abs(rng.randn(1, 2, 4, 4)).astype("float32")  # all-negative
+    out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=3, stride=2,
+                             padding=1, return_mask=True)
+    m = mask.numpy()
+    assert m.min() >= 0 and m.max() < 16
+    # every mask index must point at the cell holding the pooled value
+    o = out.numpy()
+    for n in range(1):
+        for c in range(2):
+            for i in range(o.shape[2]):
+                for j in range(o.shape[3]):
+                    flat = m[n, c, i, j]
+                    assert x[n, c, flat // 4, flat % 4] == o[n, c, i, j]
+
+
+def test_max_pool_mask_ceil_mode_in_range():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 5, 5).astype("float32")
+    _, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2,
+                           padding=0, ceil_mode=True, return_mask=True)
+    m = mask.numpy()
+    assert m.min() >= 0 and m.max() < 25
+
+
+# ---------------------------------------------------------------------------
+# HDFSClient: binary-safe cat, atomic upload
+# ---------------------------------------------------------------------------
+
+
+FAKE_HADOOP = r"""#!/bin/bash
+# minimal 'hadoop fs' double for tests; logs each call
+echo "$@" >> "$(dirname "$0")/calls.log"
+shift                       # drop 'fs'
+cmd="$1"; shift
+case "$cmd" in
+  -test) flag="$1"; path="$2"
+         case "$flag" in
+           -f) [ -f "$path" ] ;;
+           -d) [ -d "$path" ] ;;
+           *) [ -e "$path" ] ;;
+         esac ;;
+  -cat)  cat "$1" ;;
+  -put)  force=0
+         if [ "$1" = "-f" ]; then force=1; shift; fi
+         src="$1"; dst="$2"
+         if [ -e "$dst" ] && [ "$force" = 0 ]; then
+           echo "put: $dst exists" >&2; exit 1
+         fi
+         cp "$src" "$dst" ;;
+  -rm)   shift 2 2>/dev/null; rm -rf "$1" ;;
+  *)     exit 0 ;;
+esac
+"""
+
+
+@pytest.fixture
+def hdfs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+
+    home = tmp_path / "hadoop"
+    (home / "bin").mkdir(parents=True)
+    script = home / "bin" / "hadoop"
+    script.write_text(FAKE_HADOOP)
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return HDFSClient(hadoop_home=str(home)), home, tmp_path
+
+
+def test_hdfs_cat_is_binary_safe(hdfs):
+    client, home, tmp = hdfs
+    blob = bytes(range(256))  # invalid UTF-8
+    p = tmp / "ckpt.bin"
+    p.write_bytes(blob)
+    assert client.cat(str(p), binary=True) == blob
+    text = client.cat(str(p))  # decode on demand must not raise
+    assert isinstance(text, str)
+    assert client.cat(str(tmp / "missing"), binary=True) == b""
+
+
+def test_hdfs_upload_uses_put_f_not_delete(hdfs):
+    client, home, tmp = hdfs
+    src = tmp / "src.txt"
+    src.write_text("v2")
+    dst = tmp / "dst.txt"
+    dst.write_text("v1")
+    with pytest.raises(Exception):
+        client.upload(str(src), str(dst))  # overwrite=False -> error
+    client.upload(str(src), str(dst), overwrite=True)
+    assert dst.read_text() == "v2"
+    calls = (home / "bin" / "calls.log").read_text()
+    assert "-put -f" in calls
+    assert "-rm" not in calls  # no non-atomic delete-then-put window
+
+
+def test_hdfs_upload_no_overwrite_races_fail_loudly(hdfs):
+    """overwrite=False keeps the plain -put backstop: a writer that lands
+    between the is_exist check and the put must error, not clobber."""
+    client, home, tmp = hdfs
+    src = tmp / "src.txt"
+    src.write_text("mine")
+    dst = tmp / "fresh.txt"
+    client.upload(str(src), str(dst))  # no -f on the non-overwrite path
+    calls = (home / "bin" / "calls.log").read_text()
+    assert "-put -f" not in calls
+    assert dst.read_text() == "mine"
+
+
+def test_hdfs_upload_replaces_directory_target(hdfs):
+    """'-put -f file dir' would nest the file INSIDE an existing directory;
+    a dir target must be replaced by the uploaded file."""
+    client, home, tmp = hdfs
+    src = tmp / "src.txt"
+    src.write_text("v2")
+    dst = tmp / "dstdir"
+    dst.mkdir()
+    (dst / "stale").write_text("old")
+    client.upload(str(src), str(dst), overwrite=True)
+    assert dst.is_file() and dst.read_text() == "v2"
+
+
+# ---------------------------------------------------------------------------
+# Xavier/MSRA isinstance compat
+# ---------------------------------------------------------------------------
+
+
+def test_xavier_msra_isinstance():
+    from paddle_tpu.fluid import initializer as I
+    from paddle_tpu.nn import initializer as init2
+
+    x = I.Xavier()
+    assert isinstance(x, init2.XavierUniform)
+    assert isinstance(x, I.Xavier) and isinstance(x, I.XavierInitializer)
+    assert isinstance(I.Xavier(uniform=False), I.Xavier)
+    assert isinstance(init2.XavierNormal(), I.Xavier)
+    m = I.MSRA()
+    assert isinstance(m, init2.KaimingUniform) and isinstance(m, I.MSRA)
+    assert isinstance(init2.KaimingNormal(), I.MSRAInitializer)
+    assert not isinstance(x, I.MSRA)
+    # they still initialize parameters end to end
+    paddle.seed(0)
+    lin = paddle.nn.Linear(8, 4, weight_attr=paddle.ParamAttr(
+        initializer=I.Xavier()))
+    assert np.isfinite(lin.weight.numpy()).all()
+
+
+def test_xavier_subclasses_still_construct_as_themselves():
+    """The compat factory must not hijack USER subclasses of Xavier/MSRA
+    (a common v2.1 custom-initializer pattern)."""
+    from paddle_tpu.fluid import initializer as I
+
+    class MyXavier(I.Xavier):
+        def __init__(self):
+            self.custom = True
+
+    obj = MyXavier()
+    assert type(obj) is MyXavier and obj.custom
+    assert isinstance(obj, I.Xavier)

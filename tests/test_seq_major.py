@@ -1,0 +1,361 @@
+"""End-to-end seq-major GPT layout ([S, B, H] activations, GPTConfig.seq_major).
+
+Exact-parity contract vs batch-major (same seed => identical params):
+logits/loss/grads to 1e-6 on single-device, tp2, and pp2 GPT-tiny configs;
+identical decode tokens (KV cache + beam search); and ZERO layout transposes
+between the model's activations and the flash kernel's seq-major (sbnd)
+entry — asserted on the traced jaxpr.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.kernels import flash
+from paddle_tpu.models.gpt import (
+    GPTConfig,
+    GPTForPretraining,
+    GPTForPretrainingPipe,
+    GPTPretrainingCriterion,
+    build_functional_train_step,
+)
+
+CFG = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+           max_seq_len=64, dropout=0.0)
+
+
+def _pair(extra=None, seed=0):
+    """(batch-major, seq-major) models with IDENTICAL parameters."""
+    kw = dict(CFG, **(extra or {}))
+    paddle.seed(seed)
+    bm = GPTForPretraining(GPTConfig(**kw))
+    paddle.seed(seed)
+    sm = GPTForPretraining(GPTConfig(**kw, seq_major=True))
+    return bm, sm
+
+
+def _data(b=4, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, CFG["vocab_size"], (b, s)).astype("int32")
+    labels = rng.randint(0, CFG["vocab_size"], (b, s)).astype("int64")
+    return ids, labels
+
+
+# ---------------------------------------------------------------------------
+# single device
+# ---------------------------------------------------------------------------
+
+
+def test_single_device_logits_loss_grads_match():
+    bm, sm = _pair()
+    ids, labels = _data()
+    lb = bm(paddle.to_tensor(ids))
+    ls = sm(paddle.to_tensor(ids))
+    assert list(ls.shape) == [16, 4, CFG["vocab_size"]]  # [S, B, V]
+    np.testing.assert_allclose(np.transpose(ls.numpy(), (1, 0, 2)),
+                               lb.numpy(), rtol=1e-6, atol=1e-6)
+
+    loss_b = GPTPretrainingCriterion()(lb, paddle.to_tensor(labels))
+    loss_s = GPTPretrainingCriterion(seq_major=True)(
+        ls, paddle.to_tensor(labels))
+    np.testing.assert_allclose(float(loss_b.numpy()), float(loss_s.numpy()),
+                               rtol=1e-6, atol=1e-6)
+    loss_b.backward()
+    loss_s.backward()
+    for pb, ps in zip(bm.parameters(), sm.parameters()):
+        assert (pb.grad is None) == (ps.grad is None), pb.name
+        if pb.grad is not None:
+            np.testing.assert_allclose(pb.grad.numpy(), ps.grad.numpy(),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=pb.name)
+
+
+def test_single_device_criterion_loss_mask_matches():
+    bm, sm = _pair()
+    ids, labels = _data()
+    rng = np.random.RandomState(7)
+    mask = (rng.rand(*labels.shape) > 0.3).astype("float32")
+    lb = bm(paddle.to_tensor(ids))
+    ls = sm(paddle.to_tensor(ids))
+    loss_b = GPTPretrainingCriterion()(
+        lb, paddle.to_tensor(labels), paddle.to_tensor(mask))
+    loss_s = GPTPretrainingCriterion(seq_major=True)(
+        ls, paddle.to_tensor(labels), paddle.to_tensor(mask))
+    np.testing.assert_allclose(float(loss_b.numpy()), float(loss_s.numpy()),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("ce_rows", [0, 32])
+def test_functional_train_step_matches(ce_rows):
+    bm, sm = _pair()
+    ids, labels = _data()
+    sb, pb, ob = build_functional_train_step(bm, lr=1e-3, remat=False,
+                                             ce_chunk_rows=ce_rows)
+    ss, ps, os_ = build_functional_train_step(sm, lr=1e-3, remat=False,
+                                              ce_chunk_rows=ce_rows)
+    for _ in range(2):
+        pb, ob, loss_b = sb(pb, ob, ids, labels)
+        ps, os_, loss_s = ss(ps, os_, ids, labels)
+    np.testing.assert_allclose(float(np.asarray(loss_b)),
+                               float(np.asarray(loss_s)),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# tp2 / pp2
+# ---------------------------------------------------------------------------
+
+
+def test_tp2_logits_loss_grads_match():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.build_hybrid_mesh(dp=1, mp=2, pp=1, sharding=1)
+    bm, sm = _pair(extra={"use_parallel": True})
+    ids, labels = _data()
+    lb = bm(paddle.to_tensor(ids))
+    ls = sm(paddle.to_tensor(ids))
+    np.testing.assert_allclose(np.transpose(ls.numpy(), (1, 0, 2)),
+                               lb.numpy(), rtol=1e-6, atol=1e-6)
+    loss_b = GPTPretrainingCriterion()(lb, paddle.to_tensor(labels))
+    loss_s = GPTPretrainingCriterion(seq_major=True)(
+        ls, paddle.to_tensor(labels))
+    np.testing.assert_allclose(float(loss_b.numpy()), float(loss_s.numpy()),
+                               rtol=1e-6, atol=1e-6)
+    loss_b.backward()
+    loss_s.backward()
+    for pb, ps in zip(bm.parameters(), sm.parameters()):
+        if pb.grad is not None:
+            np.testing.assert_allclose(pb.grad.numpy(), ps.grad.numpy(),
+                                       rtol=1e-5, atol=1e-6, err_msg=pb.name)
+    # one compiled train step produces the same loss too
+    sb, pb_, ob = build_functional_train_step(bm, lr=1e-3, remat=False,
+                                              ce_chunk_rows=0)
+    ss, ps_, os_ = build_functional_train_step(sm, lr=1e-3, remat=False,
+                                               ce_chunk_rows=0)
+    _, _, l1 = sb(pb_, ob, ids, labels)
+    _, _, l2 = ss(ps_, os_, ids, labels)
+    np.testing.assert_allclose(float(np.asarray(l1)), float(np.asarray(l2)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def _pp_strategy(pp=2, acc=4):
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": pp,
+                        "sharding_degree": 1}
+    s.pipeline_configs = {"accumulate_steps": acc, "micro_batch_size": 2}
+    return s
+
+
+def _unique_params(layer):
+    seen, out = set(), []
+    for p in layer.parameters():
+        if id(p) not in seen:
+            seen.add(id(p))
+            out.append(p)
+    return out
+
+
+def test_pp2_pipeline_losses_match():
+    """Seq-major GPT through the 1F1B engine (microbatch scan packs the
+    batch dim) tracks the batch-major pipeline to float accuracy."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import meta_parallel as mpp
+
+    cfg_kw = dict(CFG, num_layers=4)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg_kw["vocab_size"], (8, 16)).astype("int32")
+    labels = rng.randint(0, cfg_kw["vocab_size"], (8, 16)).astype("int64")
+    fleet.init(is_collective=True, strategy=_pp_strategy())
+
+    losses = {}
+    for key, smaj in (("bm", False), ("sm", True)):
+        paddle.seed(0)
+        pipe = GPTForPretrainingPipe(GPTConfig(**cfg_kw, seq_major=smaj),
+                                     num_stages=2)
+        model = mpp.PipelineParallel(
+            pipe, fleet.get_hybrid_communicate_group(), _pp_strategy())
+        model.accumulate_steps = 4
+        o = opt.AdamW(learning_rate=1e-3, parameters=_unique_params(pipe),
+                      weight_decay=0.01,
+                      grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        ls = []
+        for _ in range(3):
+            loss = model.train_batch(
+                (paddle.to_tensor(ids), paddle.to_tensor(labels)),
+                optimizer=o)
+            ls.append(float(loss.numpy()))
+        losses[key] = ls
+    np.testing.assert_allclose(losses["bm"], losses["sm"],
+                               rtol=1e-6, atol=1e-6)
+    assert losses["sm"][-1] < losses["sm"][0]
+
+
+# ---------------------------------------------------------------------------
+# decode: KV cache + beam search
+# ---------------------------------------------------------------------------
+
+
+def test_decode_greedy_and_sampled_tokens_identical():
+    from paddle_tpu.models.generation import build_generate_fn
+
+    bm, sm = _pair()
+    ids, _ = _data(b=3, s=8)
+    gb = build_generate_fn(bm, max_new_tokens=12, greedy=True)
+    gs = build_generate_fn(sm, max_new_tokens=12, greedy=True)
+    np.testing.assert_array_equal(np.asarray(gb(ids)), np.asarray(gs(ids)))
+
+    gb2 = build_generate_fn(bm, max_new_tokens=8, greedy=False,
+                            temperature=0.8, top_k=5)
+    gs2 = build_generate_fn(sm, max_new_tokens=8, greedy=False,
+                            temperature=0.8, top_k=5)
+    np.testing.assert_array_equal(np.asarray(gb2(ids, seed=3)),
+                                  np.asarray(gs2(ids, seed=3)))
+
+
+def test_beam_search_tokens_identical():
+    from paddle_tpu.models.generation import build_beam_search_fn
+
+    bm, sm = _pair()
+    ids, _ = _data(b=3, s=8)
+    bb = build_beam_search_fn(bm, max_new_tokens=10, beam_size=3,
+                              length_penalty=0.6, eos_token_id=5)
+    bs = build_beam_search_fn(sm, max_new_tokens=10, beam_size=3,
+                              length_penalty=0.6, eos_token_id=5)
+    np.testing.assert_array_equal(np.asarray(bb(ids)), np.asarray(bs(ids)))
+
+
+# ---------------------------------------------------------------------------
+# the layout contract itself
+# ---------------------------------------------------------------------------
+
+
+def _collect_primitives(jaxpr, acc):
+    """All primitive names reachable OUTSIDE the Pallas kernel bodies — a
+    transpose inside pallas_call is the kernel's own VMEM-tile math (k.T on
+    the MXU), not a layout change around the custom call."""
+    for eqn in jaxpr.eqns:
+        acc.add(eqn.primitive.name)
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for u in vs:
+                inner = getattr(u, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _collect_primitives(inner, acc)
+                elif hasattr(u, "eqns"):
+                    _collect_primitives(u, acc)
+    return acc
+
+
+def test_no_transpose_between_model_and_flash_kernel(monkeypatch):
+    """Acceptance probe: trace GPTAttention.forward (seq-major, flash path
+    forced) and assert the jaxpr reaches the Pallas kernel without a single
+    transpose primitive — while the batch-major attention needs them."""
+    from paddle_tpu.dygraph import tracer
+    from paddle_tpu.dygraph.tensor import Tensor
+    from paddle_tpu.models import gpt as gpt_mod
+
+    monkeypatch.setattr(flash, "available", lambda: True)
+
+    kw = dict(CFG, hidden_size=64, max_seq_len=512)
+    paddle.seed(0)
+    attn_s = gpt_mod.GPTAttention(GPTConfig(**kw, seq_major=True))
+    paddle.seed(0)
+    attn_b = gpt_mod.GPTAttention(GPTConfig(**kw))
+
+    def probe(attn, shape):
+        x0 = jnp.zeros(shape, jnp.float32)
+        og = tracer.set_grad_enabled(False)
+        try:
+            jaxpr = jax.make_jaxpr(
+                lambda a: attn(Tensor(a, stop_gradient=True))._array)(x0)
+        finally:
+            tracer.set_grad_enabled(og)
+        return _collect_primitives(jaxpr.jaxpr, set())
+
+    prims_s = probe(attn_s, (512, 2, 64))   # [S, B, H]
+    assert "pallas_call" in prims_s, sorted(prims_s)
+    assert "transpose" not in prims_s, sorted(prims_s)
+
+    prims_b = probe(attn_b, (2, 512, 64))   # [B, S, H]
+    assert "pallas_call" in prims_b
+    assert "transpose" in prims_b  # the layout cost seq_major removes
+
+
+def test_flash_sbnd_matches_bnsd():
+    """The sbnd kernel specs == the bnsd path, forward AND gradients."""
+    rng = np.random.RandomState(0)
+    s, b, nh, d = 128, 2, 3, 32
+    q = jnp.asarray(rng.randn(s, b, nh, d).astype("float32"))
+    k = jnp.asarray(rng.randn(s, b, nh, d).astype("float32"))
+    v = jnp.asarray(rng.randn(s, b, nh, d).astype("float32"))
+
+    def f_sbnd(q, k, v):
+        return jnp.sum(flash.flash_attention(
+            q, k, v, causal=True, layout="sbnd", interpret=True) ** 2)
+
+    def f_bnsd(q, k, v):
+        qt, kt, vt = (jnp.transpose(a, (1, 2, 0, 3)) for a in (q, k, v))
+        out = flash.flash_attention(qt, kt, vt, causal=True, interpret=True)
+        return jnp.sum(out ** 2)
+
+    np.testing.assert_allclose(np.asarray(f_sbnd(q, k, v)),
+                               np.asarray(f_bnsd(q, k, v)), rtol=2e-5)
+    g1 = jax.grad(f_sbnd, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_bnsd, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_accepts_sbnd_layout():
+    """Ring attention (einsum and flash engines) consumes the seq-major
+    layout with the ring dim as dim 0."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.kernels.ring import ring_attention, ring_flash_attention
+
+    mesh_mod.build_hybrid_mesh(dp=1, mp=4, pp=1, sharding=1)
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 2, 128, 32
+    qb = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    kb = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    vb = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    qs, ks, vs = (jnp.transpose(a, (2, 0, 1, 3)) for a in (qb, kb, vb))
+    for causal in (False, True):
+        ref = ring_attention(qb, kb, vb, axis="mp", causal=causal,
+                             use_flash=False)
+        out = ring_attention(qs, ks, vs, axis="mp", causal=causal,
+                             use_flash=False, layout="sbnd")
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(out, (1, 2, 0, 3))), np.asarray(ref),
+            rtol=2e-5, atol=2e-5)
+        outf = ring_flash_attention(qs, ks, vs, axis="mp", causal=causal,
+                                    layout="sbnd")
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(outf, (1, 2, 0, 3))), np.asarray(ref),
+            rtol=2e-5, atol=2e-5)
+
+
+def test_parallel_cross_entropy_accepts_seq_major_logits():
+    """ParallelCrossEntropy is layout-agnostic over leading dims: [S, B, V]
+    logits + [S, B, 1] labels give the transposed batch-major losses."""
+    from paddle_tpu.distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+    rng = np.random.RandomState(0)
+    s, b, v = 8, 4, 32
+    logits = rng.randn(b, s, v).astype("float32")
+    labels = rng.randint(0, v, (b, s, 1)).astype("int64")
+    ce = ParallelCrossEntropy()
+    ref = ce(paddle.to_tensor(logits), paddle.to_tensor(labels)).numpy()
+    out = ce(paddle.to_tensor(np.transpose(logits, (1, 0, 2))),
+             paddle.to_tensor(np.transpose(labels, (1, 0, 2)))).numpy()
+    np.testing.assert_allclose(np.transpose(out, (1, 0, 2)), ref,
+                               rtol=1e-6, atol=1e-6)

@@ -134,7 +134,7 @@ case "$cmd" in
       echo "$mode $n $u $g $s $m1 $m2 $m3 $name"
     done ;;
   -mkdir) [ "$1" = "-p" ] && shift; mkdir -p "$(p "$1")" ;;
-  -put) cp -r "$1" "$(p "$2")" ;;
+  -put) [ "$1" = "-f" ] && shift; cp -r "$1" "$(p "$2")" ;;
   -get) cp -r "$(p "$1")" "$2" ;;
   -rm) [ "$1" = "-r" ] && shift; [ "$1" = "-f" ] && shift; rm -rf "$(p "$1")" ;;
   -mv) mv "$(p "$1")" "$(p "$2")" ;;
