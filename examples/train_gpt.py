@@ -39,6 +39,10 @@ def main():
                    help="[S, B, H] activation layout end-to-end "
                         "(GPTConfig.seq_major; feeds the sbnd flash entry "
                         "with zero layout transposes)")
+    p.add_argument("--int8", action="store_true",
+                   help="W8A8 int8 projections (GPTConfig.int8): real "
+                        "int8 GEMMs with dynamic per-token activation "
+                        "quant and an STE backward")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -66,7 +70,8 @@ def main():
     cfg_fn = {"tiny": gpt_mod.gpt_tiny, "small": gpt_mod.gpt_small,
               "medium": gpt_mod.gpt_medium, "1p3b": gpt_mod.gpt_1p3b,
               "13b": gpt_mod.gpt_13b}[args.config]
-    cfg = cfg_fn(use_parallel=args.mp > 1, seq_major=args.seq_major)
+    cfg = cfg_fn(use_parallel=args.mp > 1, seq_major=args.seq_major,
+                 int8=args.int8)
     seq = args.seq or min(cfg.max_seq_len, 512)
 
     paddle.seed(args.seed)

@@ -616,11 +616,11 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 
 def roi_pool(input, rois, pooled_height=1, pooled_width=1,
              spatial_scale=1.0, rois_num=None, name=None):
-    # roi_pool's max-pooled variant ~ roi_align with aligned corners off;
-    # the reference deprecated roi_pool in favor of roi_align (vision.ops)
-    from ...vision.ops import roi_align as _ra
+    # true max-over-bins RoI pooling (roi_pool_op parity) — NOT roi_align's
+    # bilinear average; vision.ops.roi_pool implements the integer-bin max
+    from ...vision.ops import roi_pool as _rp
 
-    return _ra(input, rois, boxes_num=rois_num,
+    return _rp(input, rois, boxes_num=rois_num,
                output_size=(pooled_height, pooled_width),
                spatial_scale=spatial_scale)
 

@@ -12,7 +12,33 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["Cipher", "CipherFactory", "CipherUtils"]
+__all__ = ["Cipher", "CipherFactory", "CipherUtils", "is_available"]
+
+
+def is_available() -> bool:
+    """True when the optional ``cryptography`` package is importable.
+    Key generation works without it; encrypt/decrypt do not."""
+    try:
+        import cryptography  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _aesgcm_cls():
+    """Import AESGCM at USE-time with an actionable error, so merely
+    importing this module (or collecting its tests) never requires the
+    optional dependency in minimal environments."""
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError as e:
+        raise ImportError(
+            "paddle_tpu.framework.crypto needs the optional 'cryptography' "
+            "package for AES-GCM encrypt/decrypt; install it with "
+            "`pip install cryptography` (key generation alone does not "
+            "require it)") from e
+    return AESGCM
 
 
 class Cipher:
@@ -21,7 +47,7 @@ class Cipher:
     _NONCE = 12
 
     def _aes(self, key: bytes):
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        AESGCM = _aesgcm_cls()
 
         if len(key) not in (16, 24, 32):
             raise ValueError(

@@ -55,6 +55,14 @@ def _fake_quant(x, kind: str, bits: int, layer, state_name: str,
                                          or 1.0)], "float32"),
                        stop_gradient=True)
         layer.register_buffer(state_name, scale)
+        # accumulation states for the reference moving-average recurrence
+        # (state_t = rate*state + 1, accum_t = rate*accum + cur,
+        # scale = accum/state); starting both at 0 makes the first scale
+        # exactly the first batch's abs-max — no warm-up bias
+        layer.register_buffer(state_name + "_state", Tensor(
+            np.zeros((1,), "float32"), stop_gradient=True))
+        layer.register_buffer(state_name + "_accum", Tensor(
+            np.zeros((1,), "float32"), stop_gradient=True))
     sc_in = scale
     if not fw.in_dygraph_mode():
         # static trace: address the buffer through its bound program var
@@ -65,13 +73,24 @@ def _fake_quant(x, kind: str, bits: int, layer, state_name: str,
             v = blk.create_var(name=scale.name, shape=(1,),
                                dtype="float32", persistable=True)
         sc_in = v
+    ins = {"X": [x], "InScale": [sc_in]}
+    state = getattr(layer, state_name + "_state", None)
+    accum = getattr(layer, state_name + "_accum", None)
+    training = layer.training and fw.in_dygraph_mode()
+    if training and state is not None and accum is not None:
+        # thread the accumulators so the kernel runs the stateful
+        # (bias-corrected) recurrence instead of the legacy one-buffer EMA
+        ins["InState"] = [state]
+        ins["InAccum"] = [accum]
     outs = dispatch(
-        "fake_quantize_dequantize_moving_average_abs_max",
-        {"X": [x], "InScale": [sc_in]},
+        "fake_quantize_dequantize_moving_average_abs_max", ins,
         {"bit_length": bits, "moving_rate": moving_rate,
          "is_test": not layer.training})
-    if layer.training and fw.in_dygraph_mode():
+    if training:
         scale._array = outs["OutScale"][0]._array
+        if "OutState" in outs:
+            state._array = outs["OutState"][0]._array
+            accum._array = outs["OutAccum"][0]._array
     return outs["Out"][0]
 
 

@@ -10,12 +10,21 @@ TPU-first design:
     ``lax.dynamic_update_slice`` (static shapes, no retracing per token);
   * per decode step the query is a single token, so attention is a
     (B, H, 1, S) matvec against the cache — bandwidth-bound, which is why
-    the cache lives in bf16 when the params do;
+    the cache lives in bf16 when the params do, and int8 when
+    ``GPTConfig.int8`` (or the explicit ``int8=`` knob) asks for it: int8
+    values + per-(layer, batch, head, position) fp32 scales halve the
+    dominant HBM stream again, with the dequant fused into the attention
+    einsum on-chip;
+  * with ``int8`` the QKV/output/MLP projections also run W8A8
+    (pre-quantized per-output-channel int8 weights + dynamic per-token
+    activation quant — ops/quant_ops.w8a8_apply), so decode exercises the
+    same numerics the flagship trains through;
   * sampling (greedy / temperature / top-k) runs on-device inside the
     scan with a threaded PRNG key.
 
-Supports the non-tensor-parallel ``GPTForPretraining``; mp-sharded decode
-composes with GSPMD but is not wired here.
+Tensor-parallel models work transparently: parameters are global GSPMD
+arrays carrying their 'mp' NamedShardings, so the same jitted program
+decodes on a tp mesh with XLA inserting the collectives.
 """
 
 from __future__ import annotations
@@ -29,17 +38,49 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+_tree_map = jax.tree_util.tree_map
 
-def _block_params(blk):
+
+def _block_params(blk, int8=False):
+    from ..ops.quant_ops import quantize_per_channel
+
     a, m = blk.attn, blk.mlp
-    return {
+    p = {
         "ln1_g": blk.ln1.weight._array, "ln1_b": blk.ln1.bias._array,
-        "qkv_w": a.qkv.weight._array, "qkv_b": a.qkv.bias._array,
-        "proj_w": a.proj.weight._array, "proj_b": a.proj.bias._array,
+        "qkv_b": a.qkv.bias._array, "proj_b": a.proj.bias._array,
         "ln2_g": blk.ln2.weight._array, "ln2_b": blk.ln2.bias._array,
-        "fc1_w": m.fc1.weight._array, "fc1_b": m.fc1.bias._array,
-        "fc2_w": m.fc2.weight._array, "fc2_b": m.fc2.bias._array,
+        "fc1_b": m.fc1.bias._array, "fc2_b": m.fc2.bias._array,
     }
+    for name, w in (("qkv", a.qkv.weight), ("proj", a.proj.weight),
+                    ("fc1", m.fc1.weight), ("fc2", m.fc2.weight)):
+        if int8:
+            # one-shot per-output-channel quantization at setup; decode
+            # then never touches the fp weights again
+            wq, ws = quantize_per_channel(w._array, axis=1)
+            p[name + "_wq"], p[name + "_ws"] = wq, ws
+        else:
+            p[name + "_w"] = w._array
+    return p
+
+
+def _mm(p, name, x):
+    """x @ weight — W8A8 int8 when the block params carry quantized
+    weights, plain float matmul otherwise."""
+    wq = p.get(name + "_wq")
+    if wq is not None:
+        from ..ops.quant_ops import w8a8_apply
+
+        return w8a8_apply(x, wq, p[name + "_ws"], out_dtype=x.dtype)
+    return x @ p[name + "_w"]
+
+
+def _kv_quant(blk):
+    """Symmetric int8 over the head dim: [..., D] -> (int8 [..., D],
+    fp32 scale [..., 1]) — one scale per (batch, head, position); the
+    quantization decision is the shared per-token rule."""
+    from ..ops.quant_ops import quantize_per_token
+
+    return quantize_per_token(blk)
 
 
 def _ln(x, g, b, eps):
@@ -54,7 +95,12 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
     ``x`` is (B, T, h) batch-major or (T, B, h) when ``seq_major`` — the
     model's [S, B, H] activation layout (GPTConfig.seq_major).  The KV cache
     keeps its (B, H, S, D) layout in both modes; the attention einsums
-    consume/produce the seq-major activations in place.
+    consume/produce the seq-major activations in place.  An int8 cache
+    arrives as a ``(values int8, scales fp32)`` tuple per side; the new
+    K/V block is quantized at the write and the whole cache dequantizes
+    INSIDE the attention einsum's producer (XLA fuses the elementwise
+    dequant into the dot), so HBM only ever streams int8 values + one
+    fp32 scale per (b, h, position).
 
     Works for prefill (T = prompt len, pos = 0) and decode (T = 1,
     pos = current length).  Returns (y, k_cache, v_cache)."""
@@ -64,7 +110,7 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
         b, t, h = x.shape
     hd = h // n_heads
     hx = _ln(x, p["ln1_g"], p["ln1_b"], eps)
-    qkv = hx @ p["qkv_w"] + p["qkv_b"]
+    qkv = _mm(p, "qkv", hx) + p["qkv_b"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     if seq_major:
@@ -81,39 +127,61 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
 
         q, k, v = heads(q), heads(k), heads(v)
         k_blk, v_blk = k, v
-    k_cache = lax.dynamic_update_slice(k_cache, k_blk, (0, 0, pos, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v_blk, (0, 0, pos, 0))
-    s_max = k_cache.shape[2]
+    int8_kv = isinstance(k_cache, tuple)
+    if int8_kv:
+        kq, ksc = k_cache
+        vq, vsc = v_cache
+        k_q, k_s = _kv_quant(k_blk)
+        v_q, v_s = _kv_quant(v_blk)
+        kq = lax.dynamic_update_slice(kq, k_q, (0, 0, pos, 0))
+        ksc = lax.dynamic_update_slice(ksc, k_s, (0, 0, pos, 0))
+        vq = lax.dynamic_update_slice(vq, v_q, (0, 0, pos, 0))
+        vsc = lax.dynamic_update_slice(vsc, v_s, (0, 0, pos, 0))
+        k_cache, v_cache = (kq, ksc), (vq, vsc)
+        k_eff = kq.astype(jnp.float32) * ksc
+        v_eff = vq.astype(jnp.float32) * vsc
+    else:
+        k_cache = lax.dynamic_update_slice(k_cache, k_blk, (0, 0, pos, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v_blk, (0, 0, pos, 0))
+        k_eff, v_eff = k_cache, v_cache
+    s_max = k_eff.shape[2]
     scores = jnp.einsum("tbhd,bhsd->bhts" if seq_major else "bhtd,bhsd->bhts",
-                        q, k_cache, preferred_element_type=jnp.float32)
+                        q, k_eff, preferred_element_type=jnp.float32)
     scores = scores / np.sqrt(hd).astype(np.float32)
     # causal + cache-validity mask over global positions
     q_pos = pos + jnp.arange(t)[:, None]
     kv_pos = jnp.arange(s_max)[None, :]
     mask = kv_pos <= q_pos
     scores = jnp.where(mask[None, None], scores, -1e30)
-    att = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    att = jax.nn.softmax(scores, axis=-1).astype(v_eff.dtype)
     if seq_major:
-        out = jnp.einsum("bhts,bhsd->tbhd", att, v_cache).reshape(t, b, h)
+        out = jnp.einsum("bhts,bhsd->tbhd", att, v_eff).reshape(t, b, h)
     else:
-        out = jnp.einsum("bhts,bhsd->bhtd", att, v_cache)
+        out = jnp.einsum("bhts,bhsd->bhtd", att, v_eff)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, h)
-    x = x + out @ p["proj_w"] + p["proj_b"]
+    out = out.astype(x.dtype)
+    x = x + _mm(p, "proj", out) + p["proj_b"]
     hx = _ln(x, p["ln2_g"], p["ln2_b"], eps)
-    x = x + jax.nn.gelu(hx @ p["fc1_w"] + p["fc1_b"],
-                        approximate=False) @ p["fc2_w"] + p["fc2_b"]
+    x = x + _mm(p, "fc2", jax.nn.gelu(_mm(p, "fc1", hx) + p["fc1_b"],
+                                      approximate=False)) + p["fc2_b"]
     return x, k_cache, v_cache
 
 
-def _decoder_setup(model, what="KV-cache decode"):
-    """Shared decode substrate for greedy/sampling and beam search: the
-    flat param pytree and a ``make_run(p)`` returning the cached forward
-    ``run(tokens, pos, kc, vc) -> (logits, kc, vc)``."""
+def _decoder_setup(model, int8=None):
+    """Shared decode substrate for greedy/sampling and beam search:
+    returns ``(params, make_run, int8)`` — the flat param pytree, a
+    ``make_run(p)`` producing the cached forward ``run(tokens, pos, kc,
+    vc) -> (logits, kc, vc)``, and the RESOLVED int8 flag (single source
+    of truth for both the quantized params and the cache dtype).
+
+    ``int8=None`` follows ``cfg.int8``; True quantizes the projection
+    weights (W8A8) regardless of how the model trained, so a bf16-trained
+    model can be served int8 without a copy.  TP (``use_parallel``)
+    models decode through the same program: their weights are global
+    GSPMD arrays, so XLA inserts the mp collectives."""
     cfg = model.cfg
-    if cfg.use_parallel:
-        raise NotImplementedError(
-            f"{what} is wired for the non-TP model; shard the "
-            "generate fn with GSPMD for mp decode")
+    if int8 is None:
+        int8 = bool(getattr(cfg, "int8", False))
     gpt = model.gpt
     eps = cfg.layer_norm_eps
     n_heads = cfg.num_heads
@@ -122,7 +190,7 @@ def _decoder_setup(model, what="KV-cache decode"):
         "wte": gpt.embeddings.word_embeddings.weight._array,
         "wpe": gpt.embeddings.position_embeddings.weight._array,
         "lnf_g": gpt.ln_f.weight._array, "lnf_b": gpt.ln_f.bias._array,
-        "blocks": [_block_params(b) for b in gpt.blocks],
+        "blocks": [_block_params(b, int8=int8) for b in gpt.blocks],
     }
 
     def make_run(p):
@@ -140,7 +208,10 @@ def _decoder_setup(model, what="KV-cache decode"):
                 x = p["wte"][tokens] + pe
             new_k, new_v = [], []
             for li, bp in enumerate(p["blocks"]):
-                x, k1, v1 = _block_fwd(bp, x, kc[li], vc[li], pos,
+                # per-layer cache slice / re-stack via tree ops so the int8
+                # (values, scales) tuple caches thread the same code path
+                x, k1, v1 = _block_fwd(bp, x, _tree_map(lambda a: a[li], kc),
+                                       _tree_map(lambda a: a[li], vc), pos,
                                        n_heads, eps, seq_major=seq_major)
                 new_k.append(k1)
                 new_v.append(v1)
@@ -148,28 +219,38 @@ def _decoder_setup(model, what="KV-cache decode"):
             if seq_major:
                 # callers index logits[:, -1]: keep the (B, T, V) contract
                 logits = jnp.swapaxes(logits, 0, 1)
-            return logits, jnp.stack(new_k), jnp.stack(new_v)
+            return (logits, _tree_map(lambda *xs: jnp.stack(xs), *new_k),
+                    _tree_map(lambda *xs: jnp.stack(xs), *new_v))
 
         return run
 
-    return params, make_run
+    return params, make_run, int8
 
 
-def _empty_cache(cfg, b, s_max, dtype):
+def _empty_cache(cfg, b, s_max, dtype, int8=False):
     hd = cfg.hidden_size // cfg.num_heads
     shape = (cfg.num_layers, b, cfg.num_heads, s_max, hd)
+    if int8:
+        def side():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1] + (1,), jnp.float32))
+
+        return side(), side()
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
 def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
-                      top_k: int = 0, greedy: bool = True):
+                      top_k: int = 0, greedy: bool = True,
+                      int8: Optional[bool] = None):
     """Compile ``(ids, seed) -> generated ids`` for a GPTForPretraining.
 
     Returns ``gen(ids)`` taking a (B, prompt_len) int array and returning
     (B, prompt_len + max_new_tokens) with the continuation appended.
+    ``int8`` (default: ``cfg.int8``) selects W8A8 projections + an int8
+    KV cache.
     """
     cfg = model.cfg
-    params, make_run = _decoder_setup(model)
+    params, make_run, int8 = _decoder_setup(model, int8=int8)
 
     def sample(logits, key):
         if greedy:
@@ -183,7 +264,8 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
     @functools.partial(jax.jit, static_argnums=())
     def gen(p, ids, seed):
         b, t0 = ids.shape
-        kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype)
+        kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype,
+                              int8=int8)
         run = make_run(p)
         logits, kc, vc = run(ids, 0, kc, vc)
         key = jax.random.PRNGKey(seed)
@@ -213,19 +295,22 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
 
 
 def generate(model, ids, max_new_tokens: int = 32, temperature: float = 1.0,
-             top_k: int = 0, greedy: bool = True, seed: int = 0):
+             top_k: int = 0, greedy: bool = True, seed: int = 0,
+             int8: Optional[bool] = None):
     """Convenience one-shot API (compiles per (shape, knobs))."""
     from ..dygraph.tensor import Tensor
 
     arr = ids._array if isinstance(ids, Tensor) else np.asarray(ids)
-    fn = build_generate_fn(model, max_new_tokens, temperature, top_k, greedy)
+    fn = build_generate_fn(model, max_new_tokens, temperature, top_k, greedy,
+                           int8=int8)
     out = fn(arr, seed)
     return Tensor(out, stop_gradient=True) if isinstance(ids, Tensor) else out
 
 
 def build_beam_search_fn(model, max_new_tokens: int, beam_size: int = 4,
                          length_penalty: float = 0.0,
-                         eos_token_id: Optional[int] = None):
+                         eos_token_id: Optional[int] = None,
+                         int8: Optional[bool] = None):
     """Compile beam-search decoding: ``ids (B, T0) -> (B, T0 + new)``.
 
     Role parity: the reference's ``beam_search``/``beam_search_decode`` op
@@ -243,7 +328,7 @@ def build_beam_search_fn(model, max_new_tokens: int, beam_size: int = 4,
     """
     cfg = model.cfg
     K = beam_size
-    params, make_run = _decoder_setup(model, what="beam search")
+    params, make_run, int8 = _decoder_setup(model, int8=int8)
 
     @jax.jit
     def gen(p, ids):
@@ -251,13 +336,15 @@ def build_beam_search_fn(model, max_new_tokens: int, beam_size: int = 4,
         V = p["wte"].shape[0]
         run = make_run(p)
 
-        # prefill on the B prompts, then expand to B*K beams
-        kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype)
+        # prefill on the B prompts, then expand to B*K beams (tree ops so
+        # int8 (values, scales) caches reorder alongside)
+        kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype,
+                              int8=int8)
         logits, kc, vc = run(ids, 0, kc, vc)
         lp = jax.nn.log_softmax(logits[:, -1])            # (B, V)
         scores0, tok0 = lax.top_k(lp, K)                   # (B, K)
-        kc = jnp.repeat(kc, K, axis=1)                     # rows: b*K + k
-        vc = jnp.repeat(vc, K, axis=1)
+        kc = _tree_map(lambda a: jnp.repeat(a, K, axis=1), kc)  # b*K + k
+        vc = _tree_map(lambda a: jnp.repeat(a, K, axis=1), vc)
         tokens = tok0.reshape(b * K)
         scores = scores0.reshape(b * K)
         finished = (jnp.zeros((b * K,), bool) if eos_token_id is None
@@ -279,8 +366,8 @@ def build_beam_search_fn(model, max_new_tokens: int, beam_size: int = 4,
             parent = flat // V                             # beam idx in 0..K
             new_tok = flat % V
             rows = (jnp.arange(b)[:, None] * K + parent).reshape(b * K)
-            kc2 = jnp.take(kc2, rows, axis=1)
-            vc2 = jnp.take(vc2, rows, axis=1)
+            kc2 = _tree_map(lambda a: jnp.take(a, rows, axis=1), kc2)
+            vc2 = _tree_map(lambda a: jnp.take(a, rows, axis=1), vc2)
             tokens = new_tok.reshape(b * K)
             scores = new_scores.reshape(b * K)
             finished = jnp.take(finished, rows)

@@ -45,6 +45,19 @@ class GPTConfig:
     # transposes at either end.  Batch-major stays the default until the
     # seq-major flagship point is benched (bench.py flagship_seq_major).
     seq_major: bool = False
+    # int8: W8A8 execution for the QKV/output/MLP projections — REAL int8
+    # GEMMs (per-output-channel weight quant + dynamic per-token activation
+    # quant, int32 MXU accumulation via ops/quant_ops.w8a8_matmul ->
+    # kernels/int8_gemm Pallas fusion on TPU) with a straight-through
+    # backward, so the same knob serves training (bench.py flagship_int8)
+    # and decode (models/generation.py also int8-quantizes the KV cache).
+    # Parameters stay float (AdamW masters); quantization is re-derived
+    # each step from the live weights and fused by XLA into the update.
+    int8: bool = False
+    # int8_lm_head additionally quantizes the tied LM head matmul in the
+    # eager forward (the functional train step's chunked-CE head stays
+    # float: the 50k-vocab logits are numerically the loss-critical path)
+    int8_lm_head: bool = False
 
     def __post_init__(self):
         if self.ffn_hidden is None:
@@ -74,6 +87,26 @@ def gpt_13b(**kw):
                      max_seq_len=2048, **kw)
 
 
+def w8a8_linear(x, layer):
+    """Run a Linear/ColumnParallel/RowParallel layer's weights through the
+    W8A8 int8 matmul (ops/quant_ops.w8a8_matmul: per-output-channel weight
+    quant + dynamic per-token activation quant + int8 GEMM, STE backward).
+
+    Works on the layer's PARAMETERS directly, so the int8 and bf16 models
+    share layer structure, state_dict keys and RNG consumption — same seed
+    gives identical float weights in both modes.  TP weights keep their
+    'mp' NamedShardings: the per-output-channel scale of a column-sharded
+    [in, out@'mp'] weight is itself 'mp'-sharded, so GSPMD threads the
+    scales through tp2 without explicit collectives."""
+    from ..ops.dispatch import dispatch
+
+    out = dispatch("w8a8_matmul", {"X": [x], "W": [layer.weight]}, {})
+    out = out["Out"][0]
+    if getattr(layer, "bias", None) is not None:
+        out = T.add(out, layer.bias)
+    return out
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -81,6 +114,7 @@ class GPTAttention(nn.Layer):
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.dropout = cfg.dropout
         self.seq_major = cfg.seq_major
+        self.int8 = cfg.int8
         init = nn.initializer.Normal(0.0, cfg.initializer_range)
         wa = nn.ParamAttr(initializer=init)
         if cfg.use_parallel:
@@ -96,13 +130,19 @@ class GPTAttention(nn.Layer):
             self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=wa)
             self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size, weight_attr=wa)
 
+    def _run_qkv(self, x):
+        return w8a8_linear(x, self.qkv) if self.int8 else self.qkv(x)
+
+    def _run_proj(self, x):
+        return w8a8_linear(x, self.proj) if self.int8 else self.proj(x)
+
     def forward(self, x):
         if self.seq_major:
             # [S, B, H] in, [S, B, H] out — q/k/v reach the kernel through
             # reshapes and last-dim slices only (NO transposes; the sbnd
             # kernel entry consumes the layout in place)
             s, b, h = x.shape
-            qkv = self.qkv(x)
+            qkv = self._run_qkv(x)
             local_h = qkv.shape[-1] // 3
             nh = local_h // self.head_dim
             q, k, v = T.split(qkv, 3, axis=-1)
@@ -111,9 +151,9 @@ class GPTAttention(nn.Layer):
                 T.reshape(q, shp), T.reshape(k, shp), T.reshape(v, shp),
                 is_causal=True, dropout_p=self.dropout,
                 training=self.training, layout="sbnd")
-            return self.proj(T.reshape(out, [s, b, local_h]))
+            return self._run_proj(T.reshape(out, [s, b, local_h]))
         b, s, h = x.shape
-        qkv = self.qkv(x)
+        qkv = self._run_qkv(x)
         local_h = qkv.shape[-1] // 3
         nh = local_h // self.head_dim
         # measured (flagship, v5e): the [b,nh,s,hd] transposes around the
@@ -129,12 +169,13 @@ class GPTAttention(nn.Layer):
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.dropout, training=self.training)
         out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, local_h])
-        return self.proj(out)
+        return self._run_proj(out)
 
 
 class GPTMLP(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
+        self.int8 = cfg.int8
         init = nn.initializer.Normal(0.0, cfg.initializer_range)
         wa = nn.ParamAttr(initializer=init)
         if cfg.use_parallel:
@@ -149,6 +190,8 @@ class GPTMLP(nn.Layer):
             self.fc2 = nn.Linear(cfg.ffn_hidden, cfg.hidden_size, weight_attr=wa)
 
     def forward(self, x):
+        if self.int8:
+            return w8a8_linear(F.gelu(w8a8_linear(x, self.fc1)), self.fc2)
         return self.fc2(F.gelu(self.fc1(x)))
 
 
@@ -229,6 +272,13 @@ class GPTForPretraining(nn.Layer):
     def forward(self, ids):
         x = self.gpt(ids)
         w = self.gpt.embeddings.word_embeddings.weight
+        if self.cfg.int8 and self.cfg.int8_lm_head:
+            from ..ops.dispatch import dispatch
+
+            # tied head through the same W8A8 entry ([V, H] weight,
+            # per-vocab-row scales via transpose_y)
+            return dispatch("w8a8_matmul", {"X": [x], "W": [w]},
+                            {"transpose_y": True})["Out"][0]
         return T.matmul(x, w, transpose_y=True)
 
 

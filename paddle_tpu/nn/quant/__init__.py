@@ -10,12 +10,15 @@ functional-layer shims.
 from ...incubate.quant import (  # noqa: F401
     ImperativePTQ, ImperativeQuantAware, QuantizedConv2D, QuantizedLinear,
 )
+from ...ops.quant_ops import (  # noqa: F401  (real-int8 W8A8 tier)
+    quantize_per_channel, w8a8_apply,
+)
 from ..layer_base import Layer
 from ... import tensor_api as T
 
 __all__ = ["FloatFunctionalLayer", "add", "subtract", "multiply", "divide",
            "ImperativeQuantAware", "ImperativePTQ", "QuantizedLinear",
-           "QuantizedConv2D"]
+           "QuantizedConv2D", "quantize_per_channel", "w8a8_apply"]
 
 
 class FloatFunctionalLayer(Layer):

@@ -1,18 +1,30 @@
-"""Fake-quantization kernels (QAT).
+"""Quantization kernels: fake-quant (QAT) + real int8 execution (W8A8).
 
 Parity: ``/root/reference/paddle/fluid/operators/fake_quantize_op.{cc,cu}``
 (fake_quantize_dequantize_abs_max, fake_channel_wise_*).  Straight-through
 estimator backward: the rounding is treated as identity, so the grad op is
 a plain ``assign`` (the reference registers FakeQuantDequantGradMaker with
 the same semantics).
+
+Beyond the reference's fake-quant simulation, this module carries the REAL
+int8 execution tier: ``quantized_matmul``/``quantized_conv2d`` (inference,
+pre-quantized weights) and ``w8a8_matmul`` — the fused
+dynamic-per-token-quantize + int8 GEMM entry the GPT flagship trains and
+decodes through (GPTConfig.int8), with an STE backward so
+``build_functional_train_step`` converges against the bf16 baseline.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 
 from .registry import GRAD_SUFFIX, register_op
+
+_QMAX = 127.0
+_EPS = 1e-8
 
 
 def _ste_grad_maker(op, no_grad_set):
@@ -58,17 +70,51 @@ def fake_qdq_channel_kernel(ins, attrs):
 
 
 @register_op("fake_quantize_dequantize_moving_average_abs_max",
-             nondiff_slots=("InScale",), nondiff_out_slots=("OutScale",),
+             nondiff_slots=("InScale", "InState", "InAccum"),
+             nondiff_out_slots=("OutScale", "OutState", "OutAccum"),
              grad_maker=_ste_grad_maker)
 def fake_qdq_moving_avg_kernel(ins, attrs):
-    """Activation quant: scale is a moving average of batch abs-max."""
+    """Activation quant: scale is a moving average of batch abs-max.
+
+    Reference semantics (fake_quantize_op.cc FindMovingAverageAbsMaxFunctor)
+    accumulate TWO states across steps::
+
+        state_t = rate * state_{t-1} + 1
+        accum_t = rate * accum_{t-1} + max|x_t|
+        scale_t = accum_t / state_t
+
+    i.e. a bias-corrected exponential moving average: with state/accum
+    starting at 0, scale_1 == the first batch's abs-max (no warm-up bias)
+    and scale_t -> the rate-weighted average of batch maxima.  When the
+    caller threads ``InState``/``InAccum`` (incubate.quant QAT wrappers)
+    that recurrence runs and ``OutState``/``OutAccum`` carry the updated
+    states; without them the kernel falls back to the stateless EMA
+    ``rate * scale + (1-rate) * cur`` against ``InScale`` (legacy
+    single-buffer callers).
+    """
     x, in_scale = ins["X"], ins["InScale"]
     bits = attrs.get("bit_length", 8)
     rate = attrs.get("moving_rate", 0.9)
     cur = jnp.max(jnp.abs(x))
     is_test = attrs.get("is_test", False)
-    new_scale = in_scale.reshape(()) if is_test else (
-        rate * in_scale.reshape(()) + (1.0 - rate) * cur)
+    has_state = "InState" in ins and "InAccum" in ins
+    if is_test:
+        new_scale = in_scale.reshape(())
+        outs = {"Out": _fake_qdq(x, new_scale, bits),
+                "OutScale": new_scale.reshape(1)}
+        if has_state:
+            outs["OutState"] = ins["InState"].reshape(1)
+            outs["OutAccum"] = ins["InAccum"].reshape(1)
+        return outs
+    if has_state:
+        state = rate * ins["InState"].reshape(()) + 1.0
+        accum = rate * ins["InAccum"].reshape(()) + cur
+        new_scale = accum / state
+        return {"Out": _fake_qdq(x, new_scale, bits),
+                "OutScale": new_scale.reshape(1),
+                "OutState": state.reshape(1),
+                "OutAccum": accum.reshape(1)}
+    new_scale = rate * in_scale.reshape(()) + (1.0 - rate) * cur
     return {"Out": _fake_qdq(x, new_scale, bits),
             "OutScale": new_scale.reshape(1)}
 
@@ -125,22 +171,157 @@ def quantized_matmul_kernel(ins, attrs):
     answer to the reference's TensorRT int8 engine,
     ``inference/tensorrt/trt_int8_calibrator.h``).
 
-    Y is the pre-quantized int8 weight [K, N]; WScale [N] its per-output-
-    channel dequant scale.  Activations quantize per-tensor: with a
-    calibrated ``XScale`` input (PTQ'd graphs) it is used as-is, otherwise
-    the scale is computed dynamically from the batch abs-max."""
+    Y is the pre-quantized int8 weight [K, N] — or a BATCHED stack
+    [B, K, N] against x [B, ..., K] (expert/ensemble weights); WScale [N]
+    (or [B, N]) its per-output-channel dequant scale.  Activations
+    quantize per-tensor by default: with a calibrated ``XScale`` input
+    (PTQ'd graphs) it is used as-is, otherwise the scale is computed
+    dynamically from the batch abs-max.  ``per_token=True`` switches to
+    dynamic per-row (per-token) activation scales — the W8A8 scheme the
+    GPT flagship path uses — and ignores XScale."""
     x = ins["X"]
     wq = ins["Y"]
     ws = ins["WScale"]
     xs = ins.get("XScale")
     xf = x.astype(jnp.float32)
-    if xs is None:
-        sx = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / 127.0
+    if attrs.get("per_token", False):
+        xq, sx = quantize_per_token(xf)
     else:
-        sx = jnp.maximum(xs.reshape(()).astype(jnp.float32), 1e-8) / 127.0
-    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
-    acc = jax.lax.dot_general(
-        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    out = acc.astype(jnp.float32) * (sx * ws.astype(jnp.float32))
+        if xs is None:
+            sx = jnp.maximum(jnp.max(jnp.abs(xf)), _EPS) / _QMAX
+        else:
+            sx = jnp.maximum(xs.reshape(()).astype(jnp.float32),
+                             _EPS) / _QMAX
+        xq = jnp.clip(jnp.round(xf / sx), -_QMAX, _QMAX).astype(jnp.int8)
+    wsf = ws.astype(jnp.float32)
+    if wq.ndim == 3:
+        # batched weights: contract the trailing K dim, batch over dim 0
+        acc = jax.lax.dot_general(
+            xq, wq, (((x.ndim - 1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)
+        if wsf.ndim == 2:  # [B, N] -> broadcast over the token dims
+            wsf = wsf.reshape(wsf.shape[0], *([1] * (acc.ndim - 2)),
+                              wsf.shape[1])
+        out = acc.astype(jnp.float32) * sx * wsf
+    else:
+        acc = jax.lax.dot_general(
+            xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (sx * wsf)
     return {"Out": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# W8A8: real int8 training/decode path (GPTConfig.int8)
+# ---------------------------------------------------------------------------
+
+
+def quantize_per_token(x):
+    """Dynamic symmetric per-token (per-row) int8 activation quantization:
+    (xq int8, scale fp32 [..., 1] with ``scale = max(absmax, eps)/127``).
+    THE single definition of the activation-quant decision — the Pallas
+    kernel body (kernels/int8_gemm._w8a8_kernel) mirrors it tile-locally;
+    every jnp path (matmul kernels, ref GEMM, KV-cache quant) must call
+    this so the \"identical quantization decisions\" parity contract can't
+    silently fork."""
+    xf = x.astype(jnp.float32)
+    sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                     _EPS) / _QMAX
+    xq = jnp.clip(jnp.round(xf / sx), -_QMAX, _QMAX).astype(jnp.int8)
+    return xq, sx
+
+
+def quantize_per_channel(w, axis: int = 1):
+    """Symmetric per-output-channel int8 weight quantization.
+
+    ``w`` [K, N] float with output channels on ``axis`` (default 1, the
+    Linear layout) -> (wq int8 same shape, scale float32 [N]).  Shared by
+    the model path (per-step re-quant XLA fuses into the weight update)
+    and the decode path (one-shot at setup)."""
+    wf = w.astype(jnp.float32)
+    red = tuple(i for i in range(wf.ndim) if i != axis)
+    ws = jnp.maximum(jnp.max(jnp.abs(wf), axis=red), _EPS) / _QMAX
+    shape = [1] * wf.ndim
+    shape[axis] = -1
+    wq = jnp.clip(jnp.round(wf / ws.reshape(shape)), -_QMAX, _QMAX
+                  ).astype(jnp.int8)
+    return wq, ws
+
+
+def w8a8_apply(x, wq, ws, out_dtype=None):
+    """Apply a pre-quantized int8 weight to float activations with dynamic
+    per-token activation quantization (no autodiff — the decode path).
+
+    Routes through the fused Pallas kernel (kernels/int8_gemm.py) when the
+    backend and shapes allow, else the jnp path with the same math."""
+    from ..kernels import int8_gemm
+
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    n = wq.shape[-1]
+    m = 1
+    for s in lead:
+        m *= int(s)
+    if int8_gemm.available() and int8_gemm.supported(m, k, n):
+        out = int8_gemm.w8a8_gemm(x.reshape(m, k), wq, ws)
+    else:
+        out = int8_gemm.w8a8_gemm_ref(x.reshape(m, k), wq, ws)
+    if out_dtype is not None:
+        out = out.astype(out_dtype)
+    return out.reshape(lead + (n,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _w8a8_ste(transpose_y, x, w):
+    """Differentiable W8A8 matmul: REAL int8 forward (per-output-channel
+    weight quant + dynamic per-token activation quant + int8 GEMM),
+    straight-through backward (grads computed as if the forward were the
+    plain float ``x @ w``) — the rounding is treated as identity exactly
+    like the fake-quant STE above, so AdamW sees smooth gradients while
+    the loss is computed through the deployed int8 numerics."""
+    return _w8a8_value(transpose_y, x, w)
+
+
+def _w8a8_value(transpose_y, x, w):
+    wf = w.astype(jnp.float32)
+    if transpose_y:
+        wf = wf.T
+    wq, ws = quantize_per_channel(wf, axis=1)
+    return w8a8_apply(x, wq, ws, out_dtype=x.dtype)
+
+
+def _w8a8_fwd(transpose_y, x, w):
+    return _w8a8_value(transpose_y, x, w), (x, w)
+
+
+def _w8a8_bwd(transpose_y, res, g):
+    x, w = res
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    x2 = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    g2 = gf.reshape(-1, gf.shape[-1])
+    if transpose_y:
+        dx = jnp.matmul(gf, wf)             # [.., N] @ [N, K]
+        dw = jnp.matmul(g2.T, x2)           # [N, K]
+    else:
+        dx = jnp.matmul(gf, wf.T)           # [.., N] @ [N, K]
+        dw = jnp.matmul(x2.T, g2)           # [K, N]
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_w8a8_ste.defvjp(_w8a8_fwd, _w8a8_bwd)
+
+
+@register_op("w8a8_matmul")
+def w8a8_matmul_kernel(ins, attrs):
+    """Fused dynamic-quantize + int8 matmul from FLOAT weights.
+
+    X [.., K] float activations; W [K, N] float weight ([N, K] with
+    ``transpose_y``, the tied-LM-head layout).  Quantization happens
+    inside the op each call — per-output-channel for W, per-token for X —
+    so the same entry serves training (weights move every step; XLA fuses
+    the re-quant into the step) and eager inference.  The backward is the
+    straight-through estimator, synthesized automatically from the
+    custom_vjp by the registry's auto-grad."""
+    return {"Out": _w8a8_ste(bool(attrs.get("transpose_y", False)),
+                             ins["X"], ins["W"])}

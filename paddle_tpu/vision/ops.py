@@ -23,7 +23,7 @@ from .. import tensor_api as T
 
 __all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
            "prior_box", "box_coder", "multiclass_nms", "roi_align",
-           "distribute_fpn_proposals", "generate_proposals"]
+           "roi_pool", "distribute_fpn_proposals", "generate_proposals"]
 
 
 def _trace(fn, tensors, name):
@@ -531,6 +531,78 @@ def roi_align(x, boxes, boxes_num=None, output_size=(1, 1),
     extra = ([boxes_num] if boxes_num is not None
              else ([batch_indices] if batch_indices is not None else []))
     return _trace(fn, [x, boxes] + extra, "roi_align")
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=(1, 1),
+             spatial_scale=1.0, name=None, batch_indices=None):
+    """Parity: roi_pool_op — TRUE max-over-bins RoI pooling (Fast R-CNN),
+    NOT an average of bilinear samples like roi_align.
+
+    Reference semantics (roi_pool_op.cc): roi corners are scaled by
+    ``spatial_scale`` and ROUNDED to integer pixels; the roi spans at
+    least one pixel per side (``max(x2 - x1 + 1, 1)``); each output bin
+    covers ``[floor(i*bin), ceil((i+1)*bin))`` rows/cols clipped to the
+    feature map, and emits the MAX over those cells — 0 for empty bins.
+    TPU-first shape discipline: the per-bin cell memberships become
+    boolean masks over the full H/W axes, so the pooled max is a masked
+    reduction with static shapes (no per-roi dynamic slicing)."""
+    ph, pw = ((output_size, output_size) if np.isscalar(output_size)
+              else tuple(output_size))
+    rest_is_counts = boxes_num is not None
+
+    def fn(xa, bx, *rest):
+        import jax
+        import jax.numpy as jnp
+
+        n, ch, h, w = xa.shape
+        r = bx.shape[0]
+        if rest:
+            bn = rest[0].astype(jnp.int32).reshape(-1)
+            if rest_is_counts:  # boxes_num -> batch index per roi
+                ends = jnp.cumsum(bn)
+                bidx = jnp.sum(
+                    (jnp.arange(r)[:, None] >= ends[None, :]).astype(
+                        jnp.int32), axis=1)
+            else:
+                bidx = bn
+        else:
+            bidx = jnp.zeros((r,), jnp.int32)
+        x1 = jnp.round(bx[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(bx[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(bx[:, 3] * spatial_scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        ii = jnp.arange(ph, dtype=jnp.float32)
+        jj = jnp.arange(pw, dtype=jnp.float32)
+        # [R, ph] / [R, pw] bin bounds in feature-map pixels, clipped
+        hs = jnp.clip(jnp.floor(ii[None] * bin_h[:, None]).astype(jnp.int32)
+                      + y1[:, None], 0, h)
+        he = jnp.clip(jnp.ceil((ii[None] + 1) * bin_h[:, None])
+                      .astype(jnp.int32) + y1[:, None], 0, h)
+        ws_ = jnp.clip(jnp.floor(jj[None] * bin_w[:, None]).astype(jnp.int32)
+                       + x1[:, None], 0, w)
+        we = jnp.clip(jnp.ceil((jj[None] + 1) * bin_w[:, None])
+                      .astype(jnp.int32) + x1[:, None], 0, w)
+        rows = jnp.arange(h)[None, None, :]
+        cols = jnp.arange(w)[None, None, :]
+        mh = (rows >= hs[..., None]) & (rows < he[..., None])  # [R, ph, H]
+        mw = (cols >= ws_[..., None]) & (cols < we[..., None])  # [R, pw, W]
+
+        def per_roi(b, mh_r, mw_r):
+            img = xa[b]                               # [C, H, W]
+            m = mh_r[:, None, :, None] & mw_r[None, :, None, :]
+            v = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+            out = v.max(axis=(-1, -2))                # [C, ph, pw]
+            return jnp.where(jnp.isfinite(out), out, 0.0).astype(xa.dtype)
+
+        return jax.vmap(per_roi)(bidx, mh, mw)
+
+    extra = ([boxes_num] if boxes_num is not None
+             else ([batch_indices] if batch_indices is not None else []))
+    return _trace(fn, [x, boxes] + extra, "roi_pool")
 
 
 def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,

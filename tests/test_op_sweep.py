@@ -954,6 +954,9 @@ EXEMPT = {
     "quantized_conv2d": "int8 conv execution path — predictor accuracy "
                         "contract vs fp32 (test_int8_inference."
                         "test_int8_conv_rewrite_and_numerics)",
+    "w8a8_matmul": "fused dynamic-quantize int8 matmul with custom-vjp "
+                   "STE backward — fwd accuracy + exact STE grads + "
+                   "train/decode parity (test_w8a8_gpt.py, 19 tests)",
 }
 
 # ---------------------------------------------------------------------------

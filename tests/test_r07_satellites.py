@@ -1,0 +1,277 @@
+"""ISSUE r07 satellites: true roi_pool semantics, int8 kernel correctness
+across shapes, and the moving-average fake-quant state recurrence."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# roi_pool: true max-over-bins (NOT roi_align's bilinear average)
+# ---------------------------------------------------------------------------
+
+
+def _np_roi_pool(x, boxes, bidx, ph, pw, ss):
+    n, c, h, w = x.shape
+    out = np.zeros((boxes.shape[0], c, ph, pw), x.dtype)
+    for ri in range(boxes.shape[0]):
+        x1, y1, x2, y2 = [int(round(v * ss)) for v in boxes[ri]]
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(np.floor(i * bh)) + y1, 0), h)
+                he = min(max(int(np.ceil((i + 1) * bh)) + y1, 0), h)
+                ws = min(max(int(np.floor(j * bw)) + x1, 0), w)
+                we = min(max(int(np.ceil((j + 1) * bw)) + x1, 0), w)
+                if he > hs and we > ws:
+                    out[ri, :, i, j] = x[bidx[ri], :, hs:he,
+                                         ws:we].max(axis=(1, 2))
+    return out
+
+
+def test_roi_pool_matches_numpy_reference():
+    from paddle_tpu.vision import ops as vops
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 12, 16).astype("float32")
+    boxes = np.array([[0, 0, 7, 7], [2, 3, 11, 9],
+                      [1, 1, 15, 11], [5, 2, 9, 6]], "float32")
+    bn = np.array([2, 2], "int32")
+    out = np.asarray(vops.roi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        boxes_num=paddle.to_tensor(bn), output_size=(3, 3),
+        spatial_scale=0.5).numpy())
+    ref = _np_roi_pool(x, boxes, np.array([0, 0, 1, 1]), 3, 3, 0.5)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # and it is NOT roi_align in disguise (the r05 silent-alias bug)
+    ra = np.asarray(vops.roi_align(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        boxes_num=paddle.to_tensor(bn), output_size=(3, 3),
+        spatial_scale=0.5).numpy())
+    assert np.abs(out - ra).max() > 1e-3
+
+
+def test_fluid_roi_pool_wires_true_semantics():
+    from paddle_tpu import fluid
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    boxes = np.array([[0, 0, 6, 6], [1, 2, 7, 5]], "float32")
+    out = np.asarray(fluid.layers.roi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        pooled_height=2, pooled_width=2,
+        rois_num=paddle.to_tensor(np.array([2], "int32"))).numpy())
+    ref = _np_roi_pool(x, boxes, np.array([0, 0]), 2, 2, 1.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# int8 kernel correctness across shapes (beyond the microbench)
+# ---------------------------------------------------------------------------
+
+
+def _np_quant_matmul(x, w, per_token=False):
+    ws = np.maximum(np.abs(w).max(axis=-2, keepdims=True), 1e-8) / 127.0
+    wq = np.clip(np.round(w / ws), -127, 127).astype(np.int8)
+    if per_token:
+        sx = np.maximum(np.abs(x).max(axis=-1, keepdims=True), 1e-8) / 127.0
+    else:
+        sx = np.maximum(np.abs(x).max(), 1e-8) / 127.0
+    xq = np.clip(np.round(x / sx), -127, 127).astype(np.int32)
+    acc = xq @ wq.astype(np.int32)
+    return acc.astype(np.float32) * sx * ws, wq, ws
+
+
+@pytest.mark.parametrize("shape", [((4, 8), (8, 5)),       # non-square
+                                   ((2, 3, 16), (16, 7)),  # 3-D batch dims
+                                   ((6, 24), (24, 48))])
+def test_quantized_matmul_shapes_vs_float_reference(shape, per_token=False):
+    from paddle_tpu.ops.quant_ops import quantized_matmul_kernel
+
+    xs, wsh = shape
+    rng = np.random.RandomState(3)
+    x = rng.randn(*xs).astype("float32")
+    w = rng.randn(*wsh).astype("float32")
+    ref, wq, ws = _np_quant_matmul(x, w)
+    out = np.asarray(quantized_matmul_kernel(
+        {"X": x, "Y": wq, "WScale": ws.reshape(-1).astype("float32")},
+        {})["Out"])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # the int8 result approximates the float matmul per-channel-scaled
+    assert np.abs(out - x @ w).max() < 0.05 * np.abs(x @ w).max() + 0.05
+
+
+def test_quantized_matmul_per_token_and_batched_weights():
+    from paddle_tpu.ops.quant_ops import quantized_matmul_kernel
+
+    rng = np.random.RandomState(4)
+    # per-token activation scales: one scale per row
+    x = rng.randn(3, 6, 16).astype("float32")
+    # a row with huge magnitude must not destroy other rows' precision
+    x[0, 0] *= 50.0
+    w = rng.randn(16, 8).astype("float32")
+    ref, wq, ws = _np_quant_matmul(x, w, per_token=True)
+    out = np.asarray(quantized_matmul_kernel(
+        {"X": x, "Y": wq, "WScale": ws.reshape(-1).astype("float32")},
+        {"per_token": True})["Out"])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # per-token beats per-tensor when row magnitudes are ragged
+    out_pt = np.asarray(quantized_matmul_kernel(
+        {"X": x, "Y": wq, "WScale": ws.reshape(-1).astype("float32")},
+        {})["Out"])
+    fp = x @ w
+    assert np.abs(out[1:] - fp[1:]).max() < np.abs(out_pt[1:] - fp[1:]).max()
+
+    # batched weights [B, K, N] against [B, M, K]
+    wb = rng.randn(3, 16, 8).astype("float32")
+    wsb = np.maximum(np.abs(wb).max(axis=1), 1e-8) / 127.0      # [B, N]
+    wqb = np.clip(np.round(wb / wsb[:, None, :]), -127, 127).astype(np.int8)
+    outb = np.asarray(quantized_matmul_kernel(
+        {"X": x, "Y": wqb, "WScale": wsb.astype("float32")},
+        {"per_token": True})["Out"])
+    sx = np.maximum(np.abs(x).max(axis=-1, keepdims=True), 1e-8) / 127.0
+    xq = np.clip(np.round(x / sx), -127, 127).astype(np.int32)
+    refb = np.einsum("bmk,bkn->bmn", xq, wqb.astype(np.int32)
+                     ).astype(np.float32) * sx * wsb[:, None, :]
+    np.testing.assert_allclose(outb, refb, rtol=1e-5, atol=1e-5)
+
+
+def _np_conv2d(x, w, stride, pad):
+    n, ci, h, wd = x.shape
+    co, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ho = (h + 2 * pad - kh) // stride + 1
+    wo = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, co, ho, wo), np.float64)
+    for i in range(ho):
+        for j in range(wo):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, ([1, 2, 3], [1, 2, 3]))
+    return out
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 1), (2, 0)])
+def test_quantized_conv2d_vs_numpy_reference(stride, pad):
+    from paddle_tpu.ops.quant_ops import quantized_conv2d_kernel
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(5, 3, 3, 3).astype("float32")
+    ws = np.maximum(np.abs(w).max(axis=(1, 2, 3)), 1e-8) / 127.0     # [O]
+    wq = np.clip(np.round(w / ws[:, None, None, None]), -127,
+                 127).astype(np.int8)
+    out = np.asarray(quantized_conv2d_kernel(
+        {"Input": x, "Filter": wq, "WScale": ws.astype("float32")},
+        {"strides": [stride, stride], "paddings": [pad, pad]})["Output"])
+    sx = np.maximum(np.abs(x).max(), 1e-8) / 127.0
+    xq = np.clip(np.round(x / sx), -127, 127)
+    ref = _np_conv2d(xq, wq.astype(np.float64), stride, pad) * \
+        sx * ws[None, :, None, None]
+    np.testing.assert_allclose(out, ref.astype(np.float32),
+                               rtol=1e-4, atol=1e-4)
+    # approximates the float conv
+    fp = _np_conv2d(x, w.astype(np.float64), stride, pad)
+    assert np.abs(out - fp).max() < 0.06 * np.abs(fp).max() + 0.06
+
+
+# ---------------------------------------------------------------------------
+# moving-average fake-quant: the stateful recurrence
+# ---------------------------------------------------------------------------
+
+
+def test_fake_qdq_moving_avg_state_recurrence():
+    """state_t = r*state + 1, accum_t = r*accum + max|x_t|,
+    scale_t = accum/state — verified across steps against numpy."""
+    from paddle_tpu.ops.quant_ops import fake_qdq_moving_avg_kernel
+
+    rng = np.random.RandomState(0)
+    rate = 0.9
+    state = np.zeros(1, "float32")
+    accum = np.zeros(1, "float32")
+    scale = np.ones(1, "float32")
+    for step in range(5):
+        x = rng.randn(4, 4).astype("float32") * (step + 1)
+        outs = fake_qdq_moving_avg_kernel(
+            {"X": x, "InScale": scale, "InState": state, "InAccum": accum},
+            {"moving_rate": rate})
+        exp_state = rate * state + 1.0
+        exp_accum = rate * accum + np.abs(x).max()
+        exp_scale = exp_accum / exp_state
+        np.testing.assert_allclose(np.asarray(outs["OutState"]), exp_state,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(outs["OutAccum"]), exp_accum,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(outs["OutScale"]), exp_scale,
+                                   rtol=1e-6)
+        state = np.asarray(outs["OutState"])
+        accum = np.asarray(outs["OutAccum"])
+        scale = np.asarray(outs["OutScale"])
+    # step 1 (state/accum from 0): scale == first batch abs-max exactly
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(4, 4).astype("float32")
+    outs = fake_qdq_moving_avg_kernel(
+        {"X": x0, "InScale": np.ones(1, "float32"),
+         "InState": np.zeros(1, "float32"),
+         "InAccum": np.zeros(1, "float32")}, {})
+    np.testing.assert_allclose(float(np.asarray(outs["OutScale"])[0]),
+                               np.abs(x0).max(), rtol=1e-6)
+
+
+def test_fake_qdq_moving_avg_is_test_freezes_state():
+    from paddle_tpu.ops.quant_ops import fake_qdq_moving_avg_kernel
+
+    x = np.full((2, 2), 100.0, "float32")
+    outs = fake_qdq_moving_avg_kernel(
+        {"X": x, "InScale": np.asarray([2.0], "float32"),
+         "InState": np.asarray([3.0], "float32"),
+         "InAccum": np.asarray([6.0], "float32")}, {"is_test": True})
+    np.testing.assert_allclose(np.asarray(outs["OutScale"]), [2.0])
+    np.testing.assert_allclose(np.asarray(outs["OutState"]), [3.0])
+    np.testing.assert_allclose(np.asarray(outs["OutAccum"]), [6.0])
+
+
+def test_fake_qdq_moving_avg_legacy_single_buffer_path():
+    """Without InState/InAccum the stateless EMA survives unchanged
+    (backward compat for callers threading only InScale)."""
+    from paddle_tpu.ops.quant_ops import fake_qdq_moving_avg_kernel
+
+    x = np.full((2, 2), 4.0, "float32")
+    outs = fake_qdq_moving_avg_kernel(
+        {"X": x, "InScale": np.asarray([2.0], "float32")},
+        {"moving_rate": 0.9})
+    np.testing.assert_allclose(np.asarray(outs["OutScale"]),
+                               [0.9 * 2.0 + 0.1 * 4.0], rtol=1e-6)
+    assert "OutState" not in outs
+
+
+def test_qat_wrapper_threads_state_buffers():
+    """The QAT QuantizedLinear accumulates through the stateful recurrence
+    and the states round-trip state_dict."""
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.quant import ImperativeQuantAware
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 4))
+    ImperativeQuantAware().quantize(net)
+    rng = np.random.RandomState(0)
+    maxes = []
+    for _ in range(3):
+        x = rng.randn(4, 8).astype("float32")
+        maxes.append(np.abs(x).max())
+        net(paddle.to_tensor(x))
+    # replicate: buffers start at 0; first forward also creates them, and
+    # every forward (including the first) runs the recurrence
+    state = accum = 0.0
+    for m in maxes:
+        state = 0.9 * state + 1.0
+        accum = 0.9 * accum + m
+    np.testing.assert_allclose(
+        float(np.asarray(net[0]._in_scale._array)[0]), accum / state,
+        rtol=1e-5)
+    sd = net.state_dict()
+    assert any(k.endswith("_in_scale_state") for k in sd), list(sd)
+    assert any(k.endswith("_in_scale_accum") for k in sd), list(sd)
