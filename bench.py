@@ -160,6 +160,13 @@ def main():
         decode = _decode_bench(hidden=1536, layers=24, heads=12,
                                vocab=50304, batch=8, prompt=128,
                                new_tokens=256, dtype="bfloat16")
+        # continuous batching vs static batching (ISSUE r08 acceptance:
+        # >= 1.3x aggregate decode tokens/s on the mixed-length load)
+        serving = _serving_bench(hidden=1536, layers=24, heads=12,
+                                 vocab=50304, n_requests=64, max_slots=8,
+                                 page_size=64, prompt_len=128,
+                                 new_tokens_max=256, dtype="bfloat16",
+                                 decode_block=16)
         resnet = _resnet50_bench()
         bert = _bert_bench()
         head = flagship
@@ -184,6 +191,10 @@ def main():
         decode = _decode_bench(hidden=128, layers=2, heads=2, vocab=512,
                                batch=2, prompt=16, new_tokens=16,
                                dtype="float32")
+        serving = _serving_bench(hidden=64, layers=2, heads=2, vocab=256,
+                                 n_requests=6, max_slots=2, page_size=8,
+                                 prompt_len=8, new_tokens_max=16,
+                                 dtype="float32", decode_block=4)
         small = None
 
     out = {
@@ -203,6 +214,7 @@ def main():
     out["extra"]["flagship_seq_major"] = flagship_smaj
     out["extra"]["flagship_int8"] = flagship_int8
     out["extra"]["decode"] = decode
+    out["extra"]["serving"] = serving
     if small is not None:
         out["extra"]["small_config"] = small
         out["extra"]["long_seq_config"] = long_seq
@@ -330,6 +342,132 @@ def _decode_bench(hidden=1536, layers=24, heads=12, vocab=50304, batch=8,
             "config": {"hidden": hidden, "layers": layers, "heads": heads,
                        "vocab": vocab, "batch": batch, "prompt": prompt,
                        "new_tokens": new_tokens, "dtype": dtype}}
+
+
+def _serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
+                   n_requests=64, max_slots=8, page_size=64,
+                   prompt_len=128, new_tokens_max=256, dtype="bfloat16",
+                   arrival_rate=None, int8=False, decode_block=8,
+                   seed=0):
+    """Continuous batching vs static batching on a mixed-length load.
+
+    The SAME request set — fixed-length prompts, per-request new-token
+    counts drawn from a wide (clipped-exponential) distribution, optional
+    Poisson arrivals (``arrival_rate`` req/s; None = burst at t=0) —
+    through both serving paths with the same weights and greedy sampling:
+
+      * static: ``build_generate_fn`` compiled ONCE at the service's
+        ``new_tokens_max`` limit, requests grouped FCFS into max_slots
+        batches; every sequence burns all ``new_tokens_max`` decode steps
+        and a batch admits nobody until it drains — the pre-engine
+        serving model;
+      * engine: ``serving.ServingEngine`` (paged KV pool + FCFS
+        continuous batching) admits a new request the step a slot frees.
+
+    Throughput counts USEFUL tokens only (sum of requested new-token
+    counts) over the makespan — goodput, identical numerator for both
+    paths — plus p50/p99 per-request latency (completion - arrival).
+    """
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.generation import build_generate_fn
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_seq_len=prompt_len + new_tokens_max,
+                    dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    if dtype == "bfloat16":
+        for p in model.parameters():
+            p._array = p._array.astype(jnp.bfloat16)
+
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(0, vocab, (n_requests, prompt_len)).astype("int32")
+    news = np.clip(
+        1 + rng.exponential(scale=new_tokens_max / 3.0,
+                            size=n_requests).astype(int),
+        1, new_tokens_max)
+    news[rng.randint(n_requests)] = new_tokens_max  # the tail exists
+    arrivals = (np.zeros(n_requests) if arrival_rate is None else
+                np.cumsum(rng.exponential(1.0 / arrival_rate, n_requests)))
+    useful = int(news.sum())
+
+    # -- static-batch baseline -------------------------------------------
+    fn = build_generate_fn(model, new_tokens_max, greedy=True, int8=int8)
+    np.asarray(fn(prompts[:max_slots]))  # compile + warm
+    virt_end = 0.0
+    lat_static = []
+    for i in range(0, n_requests, max_slots):
+        chunk = list(range(i, min(i + max_slots, n_requests)))
+        batch = prompts[chunk]
+        if len(chunk) < max_slots:  # keep the compiled batch shape
+            pad = np.repeat(batch[:1], max_slots - len(chunk), axis=0)
+            batch = np.concatenate([batch, pad], axis=0)
+        start = max(virt_end, float(arrivals[chunk].max()))
+        t0 = time.perf_counter()
+        np.asarray(fn(batch))
+        dt = time.perf_counter() - t0
+        virt_end = start + dt
+        lat_static.extend(virt_end - arrivals[j] for j in chunk)
+    static_res = {
+        "tokens_per_sec": round(useful / virt_end, 1),
+        "makespan_s": round(virt_end, 3),
+        "p50_latency_s": round(float(np.percentile(lat_static, 50)), 3),
+        "p99_latency_s": round(float(np.percentile(lat_static, 99)), 3),
+    }
+
+    # -- continuous-batching engine --------------------------------------
+    eng = ServingEngine(model, max_slots=max_slots, page_size=page_size,
+                        greedy=True, int8=int8,
+                        decode_block=decode_block)
+    warm = eng.add_request(prompts[0], 2)  # compile prefill + decode
+    eng.run()
+    eng.stats.update(prefill_calls=0, decode_calls=0, tokens_generated=0)
+
+    order = np.argsort(arrivals, kind="stable")
+    pending = [(float(arrivals[j]), j) for j in order]
+    rid2idx, lat_engine = {}, {}
+    t0 = time.perf_counter()
+    makespan = 0.0
+    while pending or eng.has_work:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, j = pending.pop(0)
+            rid2idx[eng.add_request(prompts[j], int(news[j]))] = j
+        if not eng.has_work:
+            if pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+            continue
+        for fin in eng.step():
+            done = time.perf_counter() - t0
+            lat_engine[rid2idx[fin.rid]] = done - arrivals[rid2idx[fin.rid]]
+            makespan = done
+    lat_e = [lat_engine[j] for j in range(n_requests)]
+    engine_res = {
+        "tokens_per_sec": round(useful / makespan, 1),
+        "makespan_s": round(makespan, 3),
+        "p50_latency_s": round(float(np.percentile(lat_e, 50)), 3),
+        "p99_latency_s": round(float(np.percentile(lat_e, 99)), 3),
+        "decode_steps": eng.stats["decode_calls"],
+        "pool_pages": eng.pool.num_pages,
+    }
+    return {
+        "static": static_res,
+        "engine": engine_res,
+        "speedup": round(engine_res["tokens_per_sec"] /
+                         max(static_res["tokens_per_sec"], 1e-9), 3),
+        "config": {"hidden": hidden, "layers": layers, "heads": heads,
+                   "vocab": vocab, "n_requests": n_requests,
+                   "max_slots": max_slots, "page_size": page_size,
+                   "prompt_len": prompt_len,
+                   "new_tokens_max": new_tokens_max, "dtype": dtype,
+                   "arrival_rate": arrival_rate, "int8": bool(int8),
+                   "decode_block": decode_block,
+                   "useful_tokens": useful},
+    }
 
 
 def make_multi_step(step, batch_arrays):

@@ -1,0 +1,99 @@
+"""Continuous-batching GPT serving demo (ISSUE r08 tentpole).
+
+Builds a GPT, queues a mixed-length request load, and drives the
+``paddle_tpu.serving.ServingEngine`` host loop step by step, printing
+admissions/completions as slots free up and are re-filled — the
+continuous-batching behavior a static-batch decoder cannot show.
+
+CPU-runnable out of the box (tiny config); flags scale it up::
+
+    python examples/serve_gpt.py                 # tiny, fp32, CPU-friendly
+    python examples/serve_gpt.py --int8          # int8 KV pages + W8A8
+    python examples/serve_gpt.py --slots 8 --page-size 32 --decode-block 8
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--requests", type=int, default=10)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--decode-block", type=int, default=1)
+    ap.add_argument("--int8", action="store_true",
+                    help="serve W8A8 projections + int8 KV pages")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="< 1.0 switches greedy off and nucleus-samples")
+    ap.add_argument("--eos", type=int, default=None,
+                    help="eos token id: finished slots free their pages")
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.max_seq, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+
+    eng = ServingEngine(model, max_slots=args.slots,
+                        page_size=args.page_size,
+                        decode_block=args.decode_block,
+                        greedy=args.top_p >= 1.0, top_p=args.top_p,
+                        eos_token_id=args.eos, int8=args.int8)
+    print(f"engine: slots={args.slots} page_size={args.page_size} "
+          f"pool={eng.pool.num_pages} pages "
+          f"({eng.pool.hbm_bytes() / 1e6:.1f} MB) int8={args.int8}")
+
+    rng = np.random.RandomState(0)
+    rids = {}
+    for i in range(args.requests):
+        plen = int(rng.randint(4, args.max_seq // 4))
+        new = int(rng.randint(4, args.max_seq // 2))
+        prompt = rng.randint(0, args.vocab, (plen,))
+        rid = eng.add_request(prompt, new)
+        rids[rid] = (plen, new)
+        print(f"  queued rid={rid} prompt_len={plen} max_new={new}")
+
+    t0 = time.perf_counter()
+    n_done, step = 0, 0
+    while eng.has_work:
+        step += 1
+        occupancy = eng.scheduler.n_active
+        for fin in eng.step():
+            n_done += 1
+            plen, new = rids[fin.rid]
+            print(f"  step {step:4d} | done rid={fin.rid} "
+                  f"({fin.finish_reason}, {len(fin.tokens)}/{new} tokens, "
+                  f"resident {fin.n_steps} steps) | "
+                  f"pool util {eng.pool.utilization():.0%} | "
+                  f"slots busy {occupancy}/{args.slots}")
+    dt = time.perf_counter() - t0
+
+    s = eng.stats
+    print(f"\n{n_done} requests, {s['tokens_generated']} tokens in {dt:.2f}s "
+          f"({s['tokens_generated'] / dt:.1f} tok/s)")
+    print(f"programs: {s['prefill_traces']} prefill trace(s) "
+          f"({s['prefill_calls']} calls), {s['decode_traces']} decode "
+          f"trace(s) ({s['decode_calls']} calls) — the engine re-USES its "
+          f"two jitted programs instead of retracing per request")
+
+
+if __name__ == "__main__":
+    main()

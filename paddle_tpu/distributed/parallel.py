@@ -71,6 +71,21 @@ def init_parallel_env() -> ParallelEnv:
     if coord and nprocs and int(nprocs) > 1:
         import jax
 
+        # Multi-PROCESS collectives on the CPU backend need the gloo
+        # transport (the default CPU client only wires intra-process
+        # device collectives and fails jitted collectives with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend").  Must be set before the backend initializes, so key
+        # off the configured platform rather than jax.default_backend().
+        plats = (jax.config.jax_platforms or os.environ.get(
+            "JAX_PLATFORMS", "")).split(",")
+        if plats and plats[0].strip() == "cpu":
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # older/newer jax without the option: keep defaults
+
         port = os.environ.get("MASTER_PORT", "8476")
         jax.distributed.initialize(
             coordinator_address=f"{coord}:{port}" if ":" not in coord else coord,

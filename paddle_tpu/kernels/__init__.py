@@ -8,3 +8,4 @@ interpreter fallback on CPU.
 """
 
 from . import attention  # noqa: F401
+from . import paged_attention  # noqa: F401

@@ -1,0 +1,219 @@
+"""Pallas TPU paged-attention decode kernel (single-query, block-table KV).
+
+Role parity: vLLM's PagedAttention decode kernel (SOSP '23) over the
+serving engine's page-pool KV cache (``serving/kv_pool.py``) — the
+continuous-batching answer to the reference inference engine's fused
+decode attention (``operators/fused/fused_multi_transformer_op.cu``).
+
+Decode attention is a (B, H, 1, S) matvec against the cache, i.e. pure
+HBM bandwidth; with a PAGED cache the valid positions of a sequence live
+scattered across pool pages, so the kernel must gather them through the
+slot's block table.  Design (pallas_guide.md):
+
+  * grid = (slots, pages-per-slot); the block table and per-slot lengths
+    ride in as SCALAR-PREFETCH args (``pltpu.PrefetchScalarGridSpec``) so
+    the K/V page picked by grid step (b, p) is ``block_table[b, p]`` —
+    the gather happens in the BlockSpec index_map, i.e. it IS the DMA
+    schedule, no materialized gather in HBM;
+  * one program holds one (H, page_size, D) K page + V page in VMEM and
+    runs the flash online-softmax recurrence (m/l/acc scratch carried
+    across the sequential page axis), masking positions >= the slot's
+    length — pages past the end contribute nothing, and the pool's
+    reserved null page (page 0) is never read unmasked;
+  * int8 pages (serving with ``int8=True``) carry fp32 per-position
+    scales; the dequant multiply happens in VMEM right after the page
+    DMA, fused into the attention compute — HBM streams int8 values +
+    one fp32 scalar per (page-position, head), exactly the layout the
+    dense int8 KV cache uses (models/generation.py), so the quantization
+    decisions carry over unchanged;
+  * ``interpret=True`` runs the identical body through the Pallas
+    interpreter (flash.py convention) and :func:`paged_attention_ref`
+    is the jnp oracle making the same masking/dequant decisions — the
+    parity contract tests/test_serving.py asserts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash import _backend_is_tpu, _x64_off
+
+_NEG_INF = -1e30
+
+
+def available() -> bool:
+    """Dispatch gate: True when the running backend executes Mosaic/Pallas
+    TPU kernels (tests monkeypatch this to force the kernel in interpret
+    mode)."""
+    return _backend_is_tpu()
+
+
+def supported(n_heads: int, page_size: int, head_dim: int) -> bool:
+    """Shape gate for the fused kernel: lane-aligned head_dim and a
+    sublane-aligned page (the int8 tile is (32, 128); bf16 is (16, 128)).
+    Ragged shapes take the jnp reference path instead of failing at
+    lowering."""
+    if head_dim % 128 != 0:
+        return False
+    if page_size % 32 != 0:
+        return False
+    # VMEM: q (H, D) + K/V pages (H, ps, D) + scratch; tiny vs 16MB/core
+    return n_heads * page_size * head_dim * 4 * 2 < 8 * 1024 * 1024
+
+
+def _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                     page_size, scale):
+    """The ONE online-softmax page step shared by the float and int8 kernel
+    entries (only how k/v are materialized in VMEM differs): init scratch
+    on the first page, score + length-mask this page, fold it into the
+    m/l/acc flash recurrence, divide out on the last page."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)                       # (H, D)
+    s = jnp.einsum("hd,hsd->hs", q, k,
+                   preferred_element_type=jnp.float32) * scale  # (H, ps)
+    base = p * jnp.int32(page_size)
+    pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    s = jnp.where(pos < len_ref[b], s, jnp.float32(_NEG_INF))
+
+    m_prev = m_ref[:, :1]                                  # (H, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new)
+    l_new = l_ref[:, :1] * alpha + jnp.sum(pexp, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.einsum(
+        "hs,hsd->hd", pexp, v, preferred_element_type=jnp.float32)
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, page_size, scale):
+    k = k_ref[0].astype(jnp.float32)                       # (H, ps, D)
+    v = v_ref[0].astype(jnp.float32)
+    _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                     page_size, scale)
+
+
+# the int8 entry has its own arity (scale refs) but the same recurrence
+def _paged_kernel_int8(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                       o_ref, m_ref, l_ref, acc_ref, *, page_size, scale):
+    # dequant fused right after the page DMA: int8 values * fp32
+    # per-(head, position) scale, in VMEM
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (H, ps, D)
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+    _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                     page_size, scale)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    k_scales=None, v_scales=None, scale=None,
+                    interpret: bool | None = None):
+    """Single-query decode attention through a paged KV pool.
+
+    ``q`` (B, H, D) float; ``k_pages``/``v_pages`` (P, H, page_size, D)
+    float — or int8 with ``k_scales``/``v_scales`` (P, H, page_size, 1)
+    fp32; ``block_tables`` (B, max_pages) int32 page ids (padding entries
+    must reference a valid page — the pool's null page 0); ``lengths``
+    (B,) int32 valid-position counts.  Returns (B, H, D) in q.dtype.
+    Callers gate on :func:`available`/:func:`supported` first.
+    """
+    b, h, d = q.shape
+    _, _, ps, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    scale = np.float32(scale)
+    if interpret is None:
+        interpret = not _backend_is_tpu()
+    int8 = k_scales is not None
+
+    q_spec = pl.BlockSpec((1, h, d), lambda b, p, bt, ln: (b, 0, 0))
+    pg_spec = pl.BlockSpec((1, h, ps, d),
+                           lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, h, ps, 1),
+                           lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
+    if int8:
+        kernel = functools.partial(_paged_kernel_int8, page_size=ps,
+                                   scale=scale)
+        in_specs = [q_spec, pg_spec, sc_spec, pg_spec, sc_spec]
+        args = (q, k_pages, k_scales, v_pages, v_scales)
+    else:
+        kernel = functools.partial(_paged_kernel, page_size=ps, scale=scale)
+        in_specs = [q_spec, pg_spec, pg_spec]
+        args = (q, k_pages, v_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, d), lambda b, p, bt, ln: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((h, 128), jnp.float32),   # running max
+                        pltpu.VMEM((h, 128), jnp.float32),   # running denom
+                        pltpu.VMEM((h, d), jnp.float32)],    # weighted acc
+    )
+    with _x64_off():
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+            interpret=interpret,
+        )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), *args)
+
+
+def gather_pages(pages, block_tables, scales=None):
+    """Materialize each slot's paged KV as a dense (B, H, S, D) view
+    (S = max_pages * page_size): ``pages[block_tables]`` + layout shuffle.
+    With int8 ``scales`` the dequant happens here, making the IDENTICAL
+    dequant decision the fused kernel makes in VMEM."""
+    p, h, ps, d = pages.shape
+    b, max_pages = block_tables.shape
+    g = pages[block_tables]                        # (B, max_pages, H, ps, D)
+    if scales is not None:
+        g = g.astype(jnp.float32) * scales[block_tables]
+    g = jnp.einsum("bphsd->bhpsd", g)
+    return g.reshape(b, h, max_pages * ps, d)
+
+
+def paged_attention_ref(q, k_pages, v_pages, block_tables, lengths, *,
+                        k_scales=None, v_scales=None, scale=None):
+    """jnp reference path: gathers the pages dense and runs the EXACT
+    einsum/mask/softmax sequence of the dense KV-cache decoder
+    (models/generation._block_fwd), so paged decode is bit-comparable to
+    dense decode — the CPU fallback and the kernel's parity oracle."""
+    b, h, d = q.shape
+    ps = k_pages.shape[2]
+    s_max = block_tables.shape[1] * ps
+    k_eff = gather_pages(k_pages, block_tables, k_scales)
+    v_eff = gather_pages(v_pages, block_tables, v_scales)
+    s = jnp.einsum("bhd,bhsd->bhs", q, k_eff,
+                   preferred_element_type=jnp.float32)
+    if scale is None:
+        # divide, exactly as the dense decoder scales its scores — keeps
+        # the two decode substrates bit-comparable, not just close
+        s = s / np.sqrt(d).astype(np.float32)
+    else:
+        s = s * jnp.float32(scale)
+    mask = jnp.arange(s_max, dtype=jnp.int32)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None], s, _NEG_INF)
+    att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
+    out = jnp.einsum("bhs,bhsd->bhd", att, v_eff)
+    return out.astype(q.dtype)

@@ -89,21 +89,14 @@ def _ln(x, g, b, eps):
     return (x - mu) / jnp.sqrt(var + eps) * g + b
 
 
-def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
-    """One decoder block over ``x`` with cache write at ``pos``.
-
-    ``x`` is (B, T, h) batch-major or (T, B, h) when ``seq_major`` — the
-    model's [S, B, H] activation layout (GPTConfig.seq_major).  The KV cache
-    keeps its (B, H, S, D) layout in both modes; the attention einsums
-    consume/produce the seq-major activations in place.  An int8 cache
-    arrives as a ``(values int8, scales fp32)`` tuple per side; the new
-    K/V block is quantized at the write and the whole cache dequantizes
-    INSIDE the attention einsum's producer (XLA fuses the elementwise
-    dequant into the dot), so HBM only ever streams int8 values + one
-    fp32 scale per (b, h, position).
-
-    Works for prefill (T = prompt len, pos = 0) and decode (T = 1,
-    pos = current length).  Returns (y, k_cache, v_cache)."""
+def _block_qkv(p, x, n_heads, eps, seq_major=False):
+    """The block's pre-attention half: LN1 + fused QKV projection + head
+    split.  Returns ``(q, k_blk, v_blk)`` with ``k_blk``/``v_blk`` in the
+    cache's (B, H, T, D) layout and ``q`` in the layout the attention
+    einsum of the caller's path wants ((T, B, H, D) seq-major, else
+    (B, H, T, D)).  Shared by the dense-cache decoder below and the
+    paged-cache serving engine (serving/engine.py) so the two decode
+    substrates cannot fork numerically."""
     if seq_major:
         t, b, h = x.shape
     else:
@@ -127,6 +120,40 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
 
         q, k, v = heads(q), heads(k), heads(v)
         k_blk, v_blk = k, v
+    return q, k_blk, v_blk
+
+
+def _block_finish(p, x, out, eps):
+    """The block's post-attention half: output projection residual + MLP
+    residual.  ``out`` is the attention output already merged back to the
+    activation layout of ``x``.  Shared with serving/engine.py."""
+    x = x + _mm(p, "proj", out) + p["proj_b"]
+    hx = _ln(x, p["ln2_g"], p["ln2_b"], eps)
+    return x + _mm(p, "fc2", jax.nn.gelu(_mm(p, "fc1", hx) + p["fc1_b"],
+                                         approximate=False)) + p["fc2_b"]
+
+
+def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
+    """One decoder block over ``x`` with cache write at ``pos``.
+
+    ``x`` is (B, T, h) batch-major or (T, B, h) when ``seq_major`` — the
+    model's [S, B, H] activation layout (GPTConfig.seq_major).  The KV cache
+    keeps its (B, H, S, D) layout in both modes; the attention einsums
+    consume/produce the seq-major activations in place.  An int8 cache
+    arrives as a ``(values int8, scales fp32)`` tuple per side; the new
+    K/V block is quantized at the write and the whole cache dequantizes
+    INSIDE the attention einsum's producer (XLA fuses the elementwise
+    dequant into the dot), so HBM only ever streams int8 values + one
+    fp32 scale per (b, h, position).
+
+    Works for prefill (T = prompt len, pos = 0) and decode (T = 1,
+    pos = current length).  Returns (y, k_cache, v_cache)."""
+    if seq_major:
+        t, b, h = x.shape
+    else:
+        b, t, h = x.shape
+    hd = h // n_heads
+    q, k_blk, v_blk = _block_qkv(p, x, n_heads, eps, seq_major=seq_major)
     int8_kv = isinstance(k_cache, tuple)
     if int8_kv:
         kq, ksc = k_cache
@@ -160,11 +187,7 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
         out = jnp.einsum("bhts,bhsd->bhtd", att, v_eff)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, h)
     out = out.astype(x.dtype)
-    x = x + _mm(p, "proj", out) + p["proj_b"]
-    hx = _ln(x, p["ln2_g"], p["ln2_b"], eps)
-    x = x + _mm(p, "fc2", jax.nn.gelu(_mm(p, "fc1", hx) + p["fc1_b"],
-                                      approximate=False)) + p["fc2_b"]
-    return x, k_cache, v_cache
+    return _block_finish(p, x, out, eps), k_cache, v_cache
 
 
 def _decoder_setup(model, int8=None):
@@ -239,27 +262,61 @@ def _empty_cache(cfg, b, s_max, dtype, int8=False):
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def _top_p_mask(logits, top_p):
+    """Nucleus filter: keep the SMALLEST prefix of descending-probability
+    tokens whose cumulative probability reaches ``top_p``; everything else
+    is masked to -1e30.  Pure jnp (sort + cumsum), runs on-device inside
+    the decode scan."""
+    sl = jnp.sort(logits, axis=-1)[..., ::-1]            # descending
+    probs = jax.nn.softmax(sl, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept while the mass BEFORE it is < top_p — the boundary
+    # token that crosses top_p stays in (standard nucleus semantics), and
+    # the top-1 token is always kept
+    keep = (cum - probs) < jnp.float32(top_p)
+    cutoff = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -1e30, logits)
+
+
+def _make_sampler(greedy: bool, temperature: float, top_k: int,
+                  top_p: float = 1.0):
+    """The on-device token sampler shared by the static-batch decoder and
+    the continuous-batching serving engine (serving/engine.py): greedy
+    argmax, or temperature -> top-k -> top-p (nucleus) -> categorical."""
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits.astype(jnp.float32) / jnp.float32(
+            max(temperature, 1e-6))
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None and top_p < 1.0:
+            logits = _top_p_mask(logits, top_p)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    return sample
+
+
 def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
                       top_k: int = 0, greedy: bool = True,
+                      top_p: float = 1.0,
+                      eos_token_id: Optional[int] = None,
                       int8: Optional[bool] = None):
     """Compile ``(ids, seed) -> generated ids`` for a GPTForPretraining.
 
     Returns ``gen(ids)`` taking a (B, prompt_len) int array and returning
     (B, prompt_len + max_new_tokens) with the continuation appended.
-    ``int8`` (default: ``cfg.int8``) selects W8A8 projections + an int8
-    KV cache.
+    ``top_p`` < 1.0 enables nucleus sampling (applied after temperature
+    and top-k).  With ``eos_token_id`` set, a sequence that emits EOS is
+    FINISHED: every later position is masked to EOS (the static-batch
+    early-stop — the scan still runs ``max_new_tokens`` steps, shapes are
+    static, but finished rows stop changing).  ``int8`` (default:
+    ``cfg.int8``) selects W8A8 projections + an int8 KV cache.
     """
     cfg = model.cfg
     params, make_run, int8 = _decoder_setup(model, int8=int8)
-
-    def sample(logits, key):
-        if greedy:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / jnp.float32(max(temperature, 1e-6))
-        if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        return jax.random.categorical(key, logits, axis=-1)
+    sample = _make_sampler(greedy, temperature, top_k, top_p)
 
     @functools.partial(jax.jit, static_argnums=())
     def gen(p, ids, seed):
@@ -271,18 +328,25 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         tok = sample(logits[:, -1], sub)
+        finished = (jnp.zeros((b,), bool) if eos_token_id is None
+                    else tok == eos_token_id)
 
         def step(carry, i):
             # carry token sits at sequence position t0 + i: process it
             # THERE (its K/V fills cache slot t0+i) and sample t0+i+1
-            tok, kc, vc, key = carry
+            tok, finished, kc, vc, key = carry
             logits, kc, vc = run(tok[:, None], t0 + i, kc, vc)
             key, sub = jax.random.split(key)
             nxt = sample(logits[:, -1], sub)
-            return (nxt, kc, vc, key), tok
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, jnp.asarray(eos_token_id,
+                                                      nxt.dtype), nxt)
+                finished = finished | (nxt == eos_token_id)
+            return (nxt, finished, kc, vc, key), tok
 
-        (last, _, _, _), toks = lax.scan(
-            step, (tok, kc, vc, key), jnp.arange(max_new_tokens - 1))
+        (last, _, _, _, _), toks = lax.scan(
+            step, (tok, finished, kc, vc, key),
+            jnp.arange(max_new_tokens - 1))
         out = jnp.concatenate(
             [toks.T, last[:, None]], axis=1) if max_new_tokens > 1 \
             else last[:, None]
@@ -296,13 +360,14 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
 
 def generate(model, ids, max_new_tokens: int = 32, temperature: float = 1.0,
              top_k: int = 0, greedy: bool = True, seed: int = 0,
+             top_p: float = 1.0, eos_token_id: Optional[int] = None,
              int8: Optional[bool] = None):
     """Convenience one-shot API (compiles per (shape, knobs))."""
     from ..dygraph.tensor import Tensor
 
     arr = ids._array if isinstance(ids, Tensor) else np.asarray(ids)
     fn = build_generate_fn(model, max_new_tokens, temperature, top_k, greedy,
-                           int8=int8)
+                           top_p=top_p, eos_token_id=eos_token_id, int8=int8)
     out = fn(arr, seed)
     return Tensor(out, stop_gradient=True) if isinstance(ids, Tensor) else out
 

@@ -1,0 +1,24 @@
+"""Continuous-batching serving subsystem (ISSUE r08 tentpole).
+
+Composes three pieces:
+
+  * :class:`~paddle_tpu.serving.kv_pool.KVPool` — page-pool KV cache
+    allocator with a reserved null page (PagedAttention, SOSP '23);
+  * :class:`~paddle_tpu.serving.scheduler.FCFSScheduler` — FCFS
+    iteration-level admission with a per-step token budget (Orca,
+    OSDI '22);
+  * :class:`~paddle_tpu.serving.engine.ServingEngine` — the host loop
+    over TWO reusable jitted programs (bucketed prefill-into-slot +
+    single decode step over the slot batch), backed by the Pallas
+    paged-attention kernel (kernels/paged_attention.py).
+
+See README "Serving" for the architecture and knobs;
+``examples/serve_gpt.py`` for the end-to-end loop.
+"""
+
+from .kv_pool import KVPool
+from .scheduler import Admission, FCFSScheduler, Request
+from .engine import FinishedRequest, ServingEngine
+
+__all__ = ["KVPool", "FCFSScheduler", "Request", "Admission",
+           "ServingEngine", "FinishedRequest"]

@@ -1,0 +1,382 @@
+"""Continuous-batching serving engine over the paged KV pool.
+
+The static-batch decoder (``models/generation.build_generate_fn``) jits
+prefill + ``max_new_tokens`` decode steps as ONE program over a fixed
+batch: finished sequences keep burning decode steps until the longest
+request ends, and a new request cannot join until the whole batch
+drains.  This engine instead runs serving as TWO reusable jitted
+programs called from a host loop:
+
+  * ``prefill``: one request's prompt through the model's existing dense
+    prefill (``_decoder_setup``'s ``make_run`` — the SAME substrate the
+    static decoder compiles, so the numerics cannot fork), its KV
+    scattered into the slot's pool pages, first token sampled.  Prompt
+    lengths are padded to power-of-two buckets so the program retraces
+    per bucket, not per length.
+  * ``decode``: ONE token for EVERY occupied slot — embedding,
+    ``_block_qkv``, per-slot paged KV write at each slot's own position,
+    paged attention through the block table (Pallas kernel on TPU, jnp
+    reference elsewhere — kernels/paged_attention.py), ``_block_finish``,
+    sampling.  Slot count is static; inactive lanes compute into the
+    pool's null page and are ignored.
+
+Every host-loop iteration the FCFS scheduler admits waiting requests
+into freed slots (per-step token budget), runs at most a handful of
+prefill calls plus exactly one decode call, and returns finished
+requests — iteration-level scheduling (Orca) with block-table paging
+(vLLM), composed with the int8 W8A8 + int8-KV serving path from the
+dense decoder: the per-(layer, batch, head, position) scale layout
+carries over to per-page scales unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import (
+    _block_finish,
+    _block_qkv,
+    _decoder_setup,
+    _empty_cache,
+    _ln,
+    _make_sampler,
+)
+from ..kernels import paged_attention as pa
+from .kv_pool import KVPool
+from .scheduler import FCFSScheduler, Request
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+    """One completed generation: the continuation (prompt excluded)."""
+
+    rid: int
+    prompt: np.ndarray
+    tokens: np.ndarray            # generated continuation, EOS included
+    finish_reason: str            # "eos" | "length"
+    n_steps: int                  # engine steps it was resident
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class _Slot:
+    """Host-side state of one occupied engine slot."""
+
+    def __init__(self, request: Request, pages: List[int]):
+        self.request = request
+        self.pages = pages
+        self.tokens: List[int] = []
+        self.born_step = 0
+
+
+class ServingEngine:
+    """Continuous-batching generation over a paged KV cache.
+
+    ``max_slots`` bounds the decode batch (the step's static shape);
+    ``page_size`` the pool granularity; ``num_pages`` the pool size
+    (default: enough for every slot at ``max_seq_len``, +1 null page);
+    ``token_budget`` the scheduler's per-step admission budget.  Sampling
+    knobs mirror ``build_generate_fn``; ``int8`` serves W8A8 projections
+    + int8 KV pages.  ``use_paged_kernel`` forces the Pallas kernel (or
+    the jnp reference) instead of auto-dispatch — tests use it to pin the
+    interpret-mode kernel path on CPU.
+    """
+
+    def __init__(self, model, *, max_slots: int = 8, page_size: int = 32,
+                 max_seq_len: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 token_budget: Optional[int] = None,
+                 greedy: bool = True, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 int8: Optional[bool] = None, seed: int = 0,
+                 decode_block: int = 1,
+                 use_paged_kernel: Optional[bool] = None):
+        cfg = model.cfg
+        self.cfg = cfg
+        # decode_block > 1 fuses that many decode steps into ONE dispatched
+        # lax.scan (multi-step scheduling): admission/finish granularity
+        # coarsens to the block, but the host->device dispatch latency —
+        # ~65ms through the TPU tunnel (bench._int8_microbench) — is paid
+        # once per block instead of once per token.  1 = pure
+        # admit-every-step continuous batching (the parity-test mode).
+        self.decode_block = max(1, int(decode_block))
+        self.params, self._make_run, self.int8 = _decoder_setup(
+            model, int8=int8)
+        self.n_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.eps = cfg.layer_norm_eps
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.max_seq_len = max_seq_len or cfg.max_seq_len
+        if self.max_seq_len > cfg.max_seq_len:
+            raise ValueError("max_seq_len exceeds the model's position table")
+        self.max_pages = -(-self.max_seq_len // page_size)
+        self.eos_token_id = eos_token_id
+        dtype = self.params["wte"].dtype
+        n_pages = num_pages or (1 + max_slots * self.max_pages)
+        self.pool = KVPool(cfg.num_layers, cfg.num_heads, self.head_dim,
+                           n_pages, page_size, dtype=dtype, int8=self.int8)
+        self.scheduler = FCFSScheduler(max_slots, self.pool,
+                                       token_budget=token_budget)
+        self._sample = _make_sampler(greedy, temperature, top_k, top_p)
+        if use_paged_kernel is None:
+            use_paged_kernel = pa.available() and pa.supported(
+                cfg.num_heads, page_size, self.head_dim)
+        self._use_kernel = bool(use_paged_kernel)
+
+        # host mirrors of the decode step's device operands
+        self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._tok = np.zeros((max_slots,), np.int32)
+        self._len = np.zeros((max_slots,), np.int32)
+        self._table = np.zeros((max_slots, self.max_pages), np.int32)
+        self._key = jax.random.PRNGKey(seed)
+        self._step_idx = 0
+        self.stats = {"prefill_calls": 0, "decode_calls": 0,
+                      "prefill_traces": 0, "decode_traces": 0,
+                      "tokens_generated": 0}
+        self._decode_fn = self._build_decode()
+        self._prefill_fn = self._build_prefill()
+
+    # -- device programs --------------------------------------------------
+
+    def _attend(self, q, bufs, li, table, lengths):
+        """Paged attention for layer ``li`` — kernel or jnp reference."""
+        if self.int8:
+            kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
+        else:
+            kw = {}
+        fn = pa.paged_attention if self._use_kernel else pa.paged_attention_ref
+        return fn(q, bufs["k"][li], bufs["v"][li], table, lengths, **kw)
+
+    def _build_decode(self):
+        n_heads, eps, ps, int8 = (self.n_heads, self.eps, self.page_size,
+                                  self.int8)
+        maxp, k_steps = self.max_pages, self.decode_block
+
+        def one_step(p, bufs, table, toks, lengths, active, key):
+            from ..ops.quant_ops import quantize_per_token
+
+            s = toks.shape[0]
+            x = (p["wte"][toks] + p["wpe"][lengths])[:, None, :]  # (S, 1, h)
+            page_idx = jnp.minimum(lengths // ps, maxp - 1)
+            # exhausted/inactive lanes park their writes on the null page
+            rows = jnp.where(active, table[jnp.arange(s), page_idx], 0)
+            offs = lengths % ps
+            for li, bp in enumerate(p["blocks"]):
+                q, kb, vb = _block_qkv(bp, x, n_heads, eps)
+                q1, k1, v1 = q[:, :, 0], kb[:, :, 0], vb[:, :, 0]  # (S, H, D)
+                if int8:
+                    kq, ksc = quantize_per_token(k1)
+                    vq, vsc = quantize_per_token(v1)
+                    bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(kq)
+                    bufs["ks"] = bufs["ks"].at[li, rows, :, offs, :].set(ksc)
+                    bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(vq)
+                    bufs["vs"] = bufs["vs"].at[li, rows, :, offs, :].set(vsc)
+                else:
+                    bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(k1)
+                    bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(v1)
+                out = self._attend(q1, bufs, li, table, lengths + 1)
+                out = out.reshape(s, -1)[:, None, :].astype(x.dtype)
+                x = _block_finish(bp, x, out, eps)
+            h = _ln(x[:, 0], p["lnf_g"], p["lnf_b"], eps)
+            logits = (h @ p["wte"].T).astype(jnp.float32)          # (S, V)
+            key, sub = jax.random.split(key)
+            return bufs, self._sample(logits, sub).astype(jnp.int32)
+
+        def decode(p, bufs, toks, lengths, table, remaining, key):
+            self.stats["decode_traces"] += 1  # python side effect: per trace
+            if k_steps == 1:
+                active = remaining > 0
+                bufs, nxt = one_step(p, bufs, table, toks, lengths,
+                                     active, key)
+                return bufs, nxt[None]                             # (1, S)
+
+            def body(carry, i):
+                bufs, toks, lengths, remaining, key = carry
+                active = remaining > 0
+                key, sub = jax.random.split(key)
+                bufs, nxt = one_step(p, bufs, table, toks, lengths,
+                                     active, sub)
+                toks = jnp.where(active, nxt, toks)
+                lengths = jnp.where(active, lengths + 1, lengths)
+                remaining = jnp.maximum(remaining - 1, 0)
+                return (bufs, toks, lengths, remaining, key), nxt
+
+            (bufs, _, _, _, _), toks_all = jax.lax.scan(
+                body, (bufs, toks, lengths, remaining, key),
+                jnp.arange(k_steps))
+            return bufs, toks_all                                  # (k, S)
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def _build_prefill(self):
+        cfg, ps, int8 = self.cfg, self.page_size, self.int8
+
+        def prefill(p, bufs, tokens, length, table_row, key):
+            self.stats["prefill_traces"] += 1
+            run = self._make_run(p)
+            t_pad = tokens.shape[1]
+            kc, vc = _empty_cache(cfg, 1, t_pad, p["wte"].dtype, int8=int8)
+            logits, kc, vc = run(tokens, 0, kc, vc)
+            pos = jnp.arange(t_pad, dtype=jnp.int32)
+            # padded positions scatter into the null page (page 0)
+            pages = jnp.where(pos < length, table_row[pos // ps], 0)
+            offs = pos % ps
+
+            def scatter(buf, blk):
+                # blk (L, 1, H, T_pad, D|1) -> advanced-index layout
+                # (T_pad, L, H, D|1) for the (page, off) scatter
+                val = jnp.einsum("lbhtd->tlhd", blk)
+                return buf.at[:, pages, :, offs, :].set(val)
+
+            if int8:
+                bufs = dict(bufs, k=scatter(bufs["k"], kc[0]),
+                            ks=scatter(bufs["ks"], kc[1]),
+                            v=scatter(bufs["v"], vc[0]),
+                            vs=scatter(bufs["vs"], vc[1]))
+            else:
+                bufs = dict(bufs, k=scatter(bufs["k"], kc),
+                            v=scatter(bufs["v"], vc))
+            last = jnp.take(logits[0], length - 1, axis=0)         # (V,)
+            key, sub = jax.random.split(key)
+            tok = self._sample(last[None, :], sub)[0]
+            return bufs, tok.astype(jnp.int32)
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
+    # -- public API -------------------------------------------------------
+
+    def add_request(self, prompt, max_new_tokens: int,
+                    arrival: float = 0.0) -> int:
+        """Queue one request; returns its rid.  The prompt + continuation
+        must fit ``max_seq_len`` (the slot's block-table width)."""
+        return self._enqueue(
+            Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
+                    max_new_tokens=max_new_tokens, arrival=arrival))
+
+    def _enqueue(self, req: Request) -> int:
+        """Single admission gate for both add_request and run(): every
+        request must fit the model's position table / block-table width,
+        whichever path it arrives by."""
+        if req.total_len > self.max_seq_len:
+            raise ValueError(
+                f"request needs {req.total_len} positions; engine "
+                f"max_seq_len is {self.max_seq_len}")
+        return self.scheduler.add(req)
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _finish(self, idx: int, reason: str) -> FinishedRequest:
+        st = self._slots[idx]
+        self._slots[idx] = None
+        self._table[idx] = 0
+        self._tok[idx] = 0
+        self._len[idx] = 0
+        self.scheduler.release(idx, st.pages)
+        return FinishedRequest(
+            rid=st.request.rid, prompt=st.request.prompt,
+            tokens=np.asarray(st.tokens, np.int32), finish_reason=reason,
+            n_steps=self._step_idx - st.born_step + 1)
+
+    def step(self) -> List[FinishedRequest]:
+        """One engine iteration: admit into freed slots (prefill), then one
+        decode step over every occupied slot.  Returns requests that
+        finished this step (EOS or length)."""
+        finished: List[FinishedRequest] = []
+        self._step_idx += 1
+
+        for adm in self.scheduler.schedule_step():
+            req, idx = adm.request, adm.slot
+            st = _Slot(req, adm.pages)
+            st.born_step = self._step_idx
+            self._slots[idx] = st
+            row = np.zeros((self.max_pages,), np.int32)
+            row[:len(adm.pages)] = adm.pages
+            self._table[idx] = row
+            t_pad = min(_next_pow2(max(req.prompt_len, 8)), self.max_seq_len)
+            tokens = np.zeros((1, t_pad), np.int32)
+            tokens[0, :req.prompt_len] = req.prompt
+            self.pool.buffers, tok = self._prefill_fn(
+                self.params, self.pool.buffers, jnp.asarray(tokens),
+                jnp.int32(req.prompt_len), jnp.asarray(row),
+                self._next_key())
+            self.stats["prefill_calls"] += 1
+            tok = int(tok)
+            st.tokens.append(tok)
+            self.stats["tokens_generated"] += 1
+            self._tok[idx] = tok
+            self._len[idx] = req.prompt_len
+            if self.eos_token_id is not None and tok == self.eos_token_id:
+                finished.append(self._finish(idx, "eos"))
+            elif len(st.tokens) >= req.max_new_tokens:
+                finished.append(self._finish(idx, "length"))
+
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if active:
+            remaining = np.zeros((self.max_slots,), np.int32)
+            for idx in active:
+                st = self._slots[idx]
+                remaining[idx] = st.request.max_new_tokens - len(st.tokens)
+            self.pool.buffers, toks_all = self._decode_fn(
+                self.params, self.pool.buffers, jnp.asarray(self._tok),
+                jnp.asarray(self._len), jnp.asarray(self._table),
+                jnp.asarray(remaining), self._next_key())
+            self.stats["decode_calls"] += 1
+            toks_all = np.asarray(toks_all)                # (k, max_slots)
+            for idx in active:
+                st = self._slots[idx]
+                consumed = int(min(self.decode_block, remaining[idx]))
+                reason = None
+                for i in range(consumed):
+                    tok = int(toks_all[i, idx])
+                    st.tokens.append(tok)
+                    self.stats["tokens_generated"] += 1
+                    if (self.eos_token_id is not None
+                            and tok == self.eos_token_id):
+                        reason = "eos"
+                        break
+                if reason is None and (len(st.tokens)
+                                       >= st.request.max_new_tokens):
+                    reason = "length"
+                if reason is not None:
+                    finished.append(self._finish(idx, reason))
+                else:
+                    # mirror the DEVICE state: it advanced `consumed` steps
+                    # and its carry token is the last sampled one
+                    self._tok[idx] = int(toks_all[consumed - 1, idx])
+                    self._len[idx] += consumed
+        return finished
+
+    def run(self, requests: Optional[Sequence] = None
+            ) -> Dict[int, FinishedRequest]:
+        """Drive the host loop to completion over queued (+ given)
+        requests; returns {rid: FinishedRequest}."""
+        for r in requests or ():
+            if isinstance(r, Request):
+                self._enqueue(r)
+            else:
+                prompt, max_new = r
+                self.add_request(prompt, max_new)
+        done: Dict[int, FinishedRequest] = {}
+        while self.has_work:
+            for fin in self.step():
+                done[fin.rid] = fin
+        return done

@@ -1,0 +1,102 @@
+"""Page-pool KV cache allocator (PagedAttention / vLLM, SOSP '23).
+
+Instead of one dense (B, H, S_max, D) cache per request — which reserves
+``max_seq_len`` worth of HBM for every slot whether used or not — the KV
+cache is a POOL of fixed-size pages shared by all slots; each sequence
+owns just enough pages for its current length, recorded in a per-slot
+block table.  Freed pages return to the pool the moment a request
+finishes, which is what lets the continuous-batching engine admit a new
+request into the slot without draining the batch.
+
+Device layout (one array per side, all layers stacked so the decode jit
+threads ONE buffer pair):
+
+  * float pages: ``(L, P, H, page_size, D)`` in the model dtype;
+  * int8 pages: the same shape in int8 + an fp32 scale pool
+    ``(L, P, H, page_size, 1)`` — one scale per (layer, page-position,
+    head), the IDENTICAL per-token quantization layout the dense int8 KV
+    cache uses (models/generation.py), so the quantization decisions
+    carry over to pages unchanged.
+
+Page 0 is RESERVED as the null page: the allocator never hands it out,
+block-table padding points at it, and masked/inactive lanes write their
+garbage there — so no gather in the paged-attention kernel can ever
+index out of the pool, and no active page can be corrupted by an
+inactive lane.  Allocation itself is a host-side free list (LIFO for
+locality); the device arrays are threaded functionally through the
+engine's jitted programs and donated back each step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+
+class KVPool:
+    """Fixed-size page pool + free-list allocator for the serving engine."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_pages: int, page_size: int, dtype=jnp.float32,
+                 int8: bool = False):
+        if num_pages < 2:
+            raise ValueError("KVPool needs >= 2 pages (page 0 is the "
+                             "reserved null page)")
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.int8 = int8
+        shape = (num_layers, num_pages, num_heads, page_size, head_dim)
+        if int8:
+            self.buffers: Dict[str, jnp.ndarray] = {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+                "vs": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            }
+        else:
+            self.buffers = {"k": jnp.zeros(shape, dtype),
+                            "v": jnp.zeros(shape, dtype)}
+        # LIFO free list over pages 1..P-1; page 0 stays the null page
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    # -- allocation -------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` positions."""
+        return max(1, math.ceil(n_tokens / self.page_size))
+
+    def alloc(self, n_pages: int) -> Optional[List[int]]:
+        """Pop ``n_pages`` from the free list, or None when the pool can't
+        satisfy the request (caller keeps the request queued — FCFS)."""
+        if n_pages > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n_pages)]
+        return got
+
+    def free(self, pages: List[int]) -> None:
+        """Return a finished request's pages.  Double-free and null-page
+        free are programming errors worth failing loudly on."""
+        for p in pages:
+            if p <= 0 or p >= self.num_pages:
+                raise ValueError(f"free of invalid page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(reversed(pages))
+
+    # -- stats ------------------------------------------------------------
+
+    def utilization(self) -> float:
+        usable = self.num_pages - 1
+        return 1.0 - len(self._free) / max(usable, 1)
+
+    def hbm_bytes(self) -> int:
+        return sum(b.size * b.dtype.itemsize for b in self.buffers.values())

@@ -1,0 +1,140 @@
+"""FCFS continuous-batching scheduler (Orca, OSDI '22).
+
+The scheduler owns the WAITING queue, the slot occupancy map and the
+per-step token budget; the engine owns the device programs.  Every engine
+step asks :meth:`FCFSScheduler.schedule_step` which requests to admit
+into freed slots, then runs ONE decode step over all occupied slots —
+iteration-level scheduling instead of run-to-completion batches.
+
+Budget semantics (Orca's "token budget"): one engine step costs
+``n_active`` decode tokens (one per occupied slot) plus the FULL prompt
+length of every request admitted this step (its prefill runs before the
+step's decode).  Admission stops when the budget is spent, so a burst of
+long prompts cannot starve in-flight decodes of step latency; a lone
+request is force-admitted even over budget (no deadlock when the budget
+is smaller than a prompt).
+
+Page accounting is conservative: a request is admitted only when the pool
+can hold its WHOLE worst-case sequence (prompt + max_new_tokens), so an
+admitted request can never die of page exhaustion mid-flight (no
+preemption/swap tier — requests are small relative to the pool; add
+eviction here if that stops holding).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from .kv_pool import KVPool
+
+_rid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request: token ids in, up to ``max_new_tokens`` out."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rid: int = field(default_factory=lambda: next(_rid_counter))
+    arrival: float = 0.0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.size)
+
+    @property
+    def total_len(self) -> int:
+        return self.prompt_len + self.max_new_tokens
+
+
+@dataclass
+class Admission:
+    """One scheduling decision: request -> slot, with its pages."""
+
+    slot: int
+    request: Request
+    pages: List[int]
+
+
+class FCFSScheduler:
+    """First-come-first-served admission over a fixed slot array."""
+
+    def __init__(self, n_slots: int, pool: KVPool,
+                 token_budget: Optional[int] = None):
+        self.n_slots = n_slots
+        self.pool = pool
+        # default budget: every slot decoding plus one flagship-sized
+        # prefill per step keeps step latency bounded without starving
+        # admission
+        self.token_budget = token_budget or (n_slots + 512)
+        self.waiting: Deque[Request] = deque()
+        self._free_slots: List[int] = list(range(n_slots - 1, -1, -1))
+
+    # -- queue ------------------------------------------------------------
+
+    def add(self, request: Request) -> int:
+        max_tokens = (self.pool.num_pages - 1) * self.pool.page_size
+        if request.total_len > max_tokens:
+            raise ValueError(
+                f"request {request.rid} needs {request.total_len} tokens; "
+                f"the pool holds {max_tokens} — raise num_pages/max_seq_len")
+        self.waiting.append(request)
+        return request.rid
+
+    @property
+    def n_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def n_active(self) -> int:
+        return self.n_slots - len(self._free_slots)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.n_active > 0
+
+    # -- per-step decisions ----------------------------------------------
+
+    def schedule_step(self) -> List[Admission]:
+        """Admit FCFS from the waiting queue into free slots until slots,
+        pages or the step's token budget run out.  Head-of-line blocking
+        is intentional (FCFS fairness): if the HEAD doesn't fit we stop,
+        we don't scan deeper for a smaller request."""
+        admissions: List[Admission] = []
+        budget = self.token_budget - self.n_active
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            if req.prompt_len > budget:
+                # force-admit a lone request so an over-budget prompt can't
+                # deadlock an idle engine
+                if self.n_active > 0 or admissions:
+                    break
+            pages = self.pool.alloc(self.pool.pages_for(req.total_len))
+            if pages is None:
+                break
+            self.waiting.popleft()
+            slot = self._free_slots.pop()
+            admissions.append(Admission(slot=slot, request=req, pages=pages))
+            budget -= req.prompt_len
+        return admissions
+
+    def release(self, slot: int, pages: List[int]) -> None:
+        """A request finished: its slot and pages return to the free pools
+        (next step's schedule_step can hand them straight out again)."""
+        if slot in self._free_slots:
+            raise ValueError(f"double release of slot {slot}")
+        self.pool.free(pages)
+        self._free_slots.append(slot)

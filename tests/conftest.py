@@ -28,6 +28,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: TPU-scale / long-running benches excluded from tier-1 "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope, and every other piece

@@ -6,6 +6,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -71,3 +72,47 @@ def test_decode_bench_emits_numbers():
     assert 0.0 <= res["argmax_match"] <= 1.0
     assert res["argmax_match"] >= 0.9  # tiny config: int8 tracks fp argmax
     assert np.isfinite(res["speedup"])
+
+
+def test_serving_bench_smoke():
+    """Fast CPU smoke of bench.py's serving bench path (ISSUE r08 CI
+    satellite): the static baseline and the continuous-batching engine
+    both complete the mixed load, every request gets a latency, and the
+    report carries the throughput/latency fields the TPU run records."""
+    res = bench._serving_bench(hidden=48, layers=2, heads=2, vocab=128,
+                               n_requests=5, max_slots=2, page_size=8,
+                               prompt_len=8, new_tokens_max=12,
+                               dtype="float32", decode_block=4)
+    for side in ("static", "engine"):
+        assert res[side]["tokens_per_sec"] > 0
+        assert res[side]["p50_latency_s"] > 0
+        assert res[side]["p99_latency_s"] >= res[side]["p50_latency_s"]
+    assert res["engine"]["decode_steps"] > 0
+    assert np.isfinite(res["speedup"])
+    assert res["config"]["useful_tokens"] > 0
+
+
+def test_serving_bench_poisson_arrivals():
+    """The Poisson-arrival mode (arrival_rate set) also completes and
+    latencies stay positive (completion can't precede arrival)."""
+    res = bench._serving_bench(hidden=48, layers=2, heads=2, vocab=128,
+                               n_requests=4, max_slots=2, page_size=8,
+                               prompt_len=8, new_tokens_max=8,
+                               dtype="float32", decode_block=2,
+                               arrival_rate=200.0)
+    assert res["engine"]["p50_latency_s"] > 0
+    assert res["static"]["p50_latency_s"] > 0
+
+
+@pytest.mark.slow
+def test_serving_bench_tpu_scale():
+    """The flagship-sized serving point bench.py records on TPU (marked
+    slow: hours on CPU, minutes on a v5e).  The r08 acceptance bar lives
+    here: continuous batching must deliver >= 1.3x aggregate tokens/s
+    over static batching on the mixed-length load."""
+    res = bench._serving_bench(hidden=1536, layers=24, heads=12,
+                               vocab=50304, n_requests=64, max_slots=8,
+                               page_size=64, prompt_len=128,
+                               new_tokens_max=256, dtype="bfloat16",
+                               decode_block=16)
+    assert res["speedup"] >= 1.3, res

@@ -59,6 +59,59 @@ def test_sampling_modes_and_single_token():
     assert (s1 < 64).all() and (s1 >= 0).all()
 
 
+def test_top_p_tiny_nucleus_equals_greedy():
+    """top_p -> 0 keeps only the argmax token in the nucleus, so nucleus
+    SAMPLING must reproduce the greedy continuation exactly."""
+    paddle.seed(4)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    ids = np.random.RandomState(4).randint(0, 128, (2, 5)).astype("int64")
+    g = np.asarray(generate(model, ids, max_new_tokens=8, greedy=True))
+    s = np.asarray(generate(model, ids, max_new_tokens=8, greedy=False,
+                            temperature=1.0, top_p=1e-6, seed=11))
+    np.testing.assert_array_equal(g, s)
+
+
+def test_top_p_seeded_deterministic_and_noop_at_one():
+    """top_p=1.0 is a no-op (bit-identical to plain sampling under the same
+    seed) and any top_p is deterministic per seed."""
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=32, dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    ids = np.random.RandomState(5).randint(0, 96, (1, 4)).astype("int64")
+    plain = np.asarray(generate(model, ids, max_new_tokens=6, greedy=False,
+                                temperature=0.9, seed=3))
+    noop = np.asarray(generate(model, ids, max_new_tokens=6, greedy=False,
+                               temperature=0.9, top_p=1.0, seed=3))
+    np.testing.assert_array_equal(plain, noop)
+    a = np.asarray(generate(model, ids, max_new_tokens=6, greedy=False,
+                            temperature=0.9, top_p=0.7, seed=3))
+    b = np.asarray(generate(model, ids, max_new_tokens=6, greedy=False,
+                            temperature=0.9, top_p=0.7, seed=3))
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < 96).all()
+
+
+def test_top_p_mask_keeps_minimal_nucleus():
+    """Unit check of the filter itself: the kept set is the smallest
+    descending-probability prefix reaching top_p."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.generation import _top_p_mask
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    out = np.asarray(_top_p_mask(logits, 0.6))
+    # 0.5 < 0.6 -> token 1 (0.25) completes the nucleus; 2, 3 masked
+    assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+    assert out[0, 2] <= -1e29 and out[0, 3] <= -1e29
+    out2 = np.asarray(_top_p_mask(logits, 0.4))
+    assert np.isfinite(out2[0, 0]) and (out2[0, 1:] <= -1e29).all()
+
+
 def test_beam_search_beam1_matches_greedy():
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,

@@ -1,0 +1,347 @@
+"""Continuous-batching serving engine + paged KV cache (ISSUE r08).
+
+Acceptance contracts, all CPU-runnable:
+  * the Pallas paged-attention kernel (interpret mode — the exact TPU code
+    path) matches the jnp reference for bf16-style float and int8 pages;
+  * paged decode produces EXACTLY the dense-KV-cache decoder's greedy
+    tokens (fp and int8, jnp path and interpret-kernel path, single device
+    and tp2, decode_block 1 and >1) on mixed-length prompts;
+  * the pool allocator and FCFS scheduler enforce their invariants (null
+    page, double-free, FCFS order, token budget, page-limited admission);
+  * EOS frees the slot and its pages mid-flight and the engine admits the
+    next waiting request into them.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels import paged_attention as pa
+from paddle_tpu.models.generation import build_generate_fn
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import FCFSScheduler, KVPool, Request, ServingEngine
+
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+           max_seq_len=96, dropout=0.0)
+
+
+def _model(seed=3, **over):
+    paddle.seed(seed)
+    m = GPTForPretraining(GPTConfig(**{**CFG, **over}))
+    m.eval()
+    return m
+
+
+def _prompts(rng, lens, vocab=512):
+    return [rng.randint(0, vocab, (n,)).astype("int32") for n in lens]
+
+
+def _dense_greedy(model, prompts, n, int8=False):
+    """Per-request static-batch reference continuations."""
+    outs = []
+    for p in prompts:
+        fn = build_generate_fn(model, n, greedy=True, int8=int8)
+        outs.append(np.asarray(fn(p[None]))[0, len(p):])
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def test_paged_kernel_matches_ref_float():
+    rng = np.random.RandomState(0)
+    B, H, D, PS, MAXP, P = 3, 2, 16, 8, 4, 10
+    q = jnp.asarray(rng.randn(B, H, D).astype("float32"))
+    kp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    vp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    bt = jnp.asarray(rng.randint(1, P, (B, MAXP)).astype("int32"))
+    lens = jnp.asarray(np.array([5, 17, 32], "int32"))
+    out = pa.paged_attention(q, kp, vp, bt, lens, interpret=True)
+    ref = pa.paged_attention_ref(q, kp, vp, bt, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_kernel_matches_ref_int8():
+    from paddle_tpu.ops.quant_ops import quantize_per_token
+
+    rng = np.random.RandomState(1)
+    B, H, D, PS, MAXP, P = 2, 3, 16, 8, 3, 8
+    q = jnp.asarray(rng.randn(B, H, D).astype("float32"))
+    kp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    vp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    kq, ks = quantize_per_token(kp)
+    vq, vs = quantize_per_token(vp)
+    bt = jnp.asarray(rng.randint(1, P, (B, MAXP)).astype("int32"))
+    lens = jnp.asarray(np.array([3, 21], "int32"))
+    out = pa.paged_attention(q, kq, vq, bt, lens, k_scales=ks, v_scales=vs,
+                             interpret=True)
+    ref = pa.paged_attention_ref(q, kq, vq, bt, lens, k_scales=ks,
+                                 v_scales=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # int8 pages approximate the float pages (quantization error band)
+    full = pa.paged_attention_ref(q, kp, vp, bt, lens)
+    assert np.abs(np.asarray(ref) - np.asarray(full)).max() < 0.15
+
+
+def test_paged_ref_masks_beyond_length():
+    """Positions past `lengths` cannot influence the output: rewriting
+    them (e.g. the null page filling with garbage) changes nothing."""
+    rng = np.random.RandomState(2)
+    P, H, PS, D = 6, 2, 8, 16
+    q = jnp.asarray(rng.randn(1, H, D).astype("float32"))
+    kp = rng.randn(P, H, PS, D).astype("float32")
+    vp = rng.randn(P, H, PS, D).astype("float32")
+    bt = jnp.asarray(np.array([[1, 2, 3]], "int32"))
+    lens = jnp.asarray(np.array([11], "int32"))
+    a = pa.paged_attention_ref(q, jnp.asarray(kp), jnp.asarray(vp), bt, lens)
+    kp2, vp2 = kp.copy(), vp.copy()
+    kp2[2, :, 3:] = 99.0   # page 2 holds positions 8..15; 11.. are masked
+    vp2[2, :, 3:] = -99.0
+    kp2[3], vp2[3] = 7.0, 7.0   # page 3 fully masked
+    b = pa.paged_attention_ref(q, jnp.asarray(kp2), jnp.asarray(vp2), bt,
+                               lens)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# pool + scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_kv_pool_alloc_free_invariants():
+    pool = KVPool(2, 2, 16, num_pages=8, page_size=4)
+    assert pool.num_free == 7  # page 0 reserved
+    a = pool.alloc(3)
+    b = pool.alloc(4)
+    assert pool.alloc(1) is None  # exhausted
+    assert 0 not in a + b  # null page never handed out
+    assert len(set(a + b)) == 7
+    pool.free(a)
+    assert pool.num_free == 3
+    with pytest.raises(ValueError):
+        pool.free(a)  # double free
+    with pytest.raises(ValueError):
+        pool.free([0])  # null page
+    assert pool.pages_for(1) == 1 and pool.pages_for(4) == 1
+    assert pool.pages_for(5) == 2
+    c = pool.alloc(3)
+    assert sorted(c) == sorted(a)  # freed pages recycle
+    assert pool.buffers["k"].shape == (2, 8, 2, 4, 16)
+
+
+def test_scheduler_fcfs_budget_and_pages():
+    pool = KVPool(1, 1, 8, num_pages=9, page_size=4)
+    sched = FCFSScheduler(n_slots=4, pool=pool, token_budget=10)
+    rng = np.random.RandomState(0)
+    reqs = [Request(prompt=rng.randint(0, 9, (n,)), max_new_tokens=4)
+            for n in (6, 6, 6)]
+    for r in reqs:
+        sched.add(r)
+    adm = sched.schedule_step()
+    # budget 10: first prompt (6) fits, second (6) would exceed -> FCFS stop
+    assert [a.request.rid for a in adm] == [reqs[0].rid]
+    adm2 = sched.schedule_step()
+    assert [a.request.rid for a in adm2] == [reqs[1].rid]
+    # third blocked on PAGES now: 2 x ceil(10/4)=3 pages taken, 2 free < 3
+    assert sched.schedule_step() == []
+    sched.release(adm[0].slot, adm[0].pages)
+    adm3 = sched.schedule_step()
+    assert [a.request.rid for a in adm3] == [reqs[2].rid]
+
+
+def test_scheduler_force_admits_over_budget_when_idle():
+    pool = KVPool(1, 1, 8, num_pages=20, page_size=4)
+    sched = FCFSScheduler(n_slots=2, pool=pool, token_budget=4)
+    big = Request(prompt=np.arange(30), max_new_tokens=2)
+    sched.add(big)
+    adm = sched.schedule_step()  # idle engine: over-budget prompt admitted
+    assert [a.request.rid for a in adm] == [big.rid]
+
+
+def test_scheduler_rejects_oversized_request():
+    pool = KVPool(1, 1, 8, num_pages=4, page_size=4)  # 12 usable tokens
+    sched = FCFSScheduler(n_slots=2, pool=pool)
+    with pytest.raises(ValueError):
+        sched.add(Request(prompt=np.arange(20), max_new_tokens=4))
+
+
+# ---------------------------------------------------------------------------
+# engine parity vs the dense static-batch decoder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["jnp", "kernel", "jnp_block4",
+                                  "kernel_block4"])
+def test_engine_greedy_matches_dense_decode(mode):
+    """Mixed-length prompts through the engine == per-request static-batch
+    greedy decode, exactly (the r08 acceptance contract), with the paged
+    path forced through the jnp reference or the interpret-mode kernel."""
+    model = _model()
+    rng = np.random.RandomState(3)
+    prompts = _prompts(rng, (5, 11, 23, 7))
+    refs = _dense_greedy(model, prompts, 12)
+    eng = ServingEngine(model, max_slots=2, page_size=8,
+                        decode_block=4 if "block4" in mode else 1,
+                        use_paged_kernel="kernel" in mode)
+    rids = [eng.add_request(p, 12) for p in prompts]
+    out = eng.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(out[rid].tokens, refs[i])
+    # continuous batching really reused its two programs: ONE decode trace
+    # and one prefill trace per prompt-length bucket
+    assert eng.stats["decode_traces"] == 1
+    assert eng.stats["prefill_traces"] <= 3  # buckets: 8, 16, 32
+
+
+@pytest.mark.parametrize("mode", ["jnp", "kernel"])
+def test_engine_int8_matches_dense_int8_decode(mode):
+    """int8 paged decode (int8 pages + fp32 page scales, W8A8 projections)
+    == the dense int8-KV decoder, exactly, on the test configs."""
+    model = _model()
+    rng = np.random.RandomState(5)
+    prompts = _prompts(rng, (6, 13, 9))
+    refs = _dense_greedy(model, prompts, 10, int8=True)
+    eng = ServingEngine(model, max_slots=2, page_size=8, int8=True,
+                        use_paged_kernel=mode == "kernel")
+    assert eng.pool.buffers["k"].dtype == jnp.int8
+    assert eng.pool.buffers["ks"].dtype == jnp.float32
+    rids = [eng.add_request(p, 10) for p in prompts]
+    out = eng.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(out[rid].tokens, refs[i])
+
+
+def test_engine_tp2_matches_single_device():
+    """tp2 engine decode (use_parallel weights on an mp=2 mesh, GSPMD
+    global arrays) reproduces the single-device dense greedy tokens."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    single = _model(seed=0)
+    rng = np.random.RandomState(0)
+    prompts = _prompts(rng, (5, 9))
+    refs = _dense_greedy(single, prompts, 8)
+
+    mesh_mod.build_hybrid_mesh(dp=1, mp=2, pp=1, sharding=1)
+    paddle.seed(0)
+    tp = GPTForPretraining(GPTConfig(**CFG, use_parallel=True))
+    tp.eval()
+    for int8 in (False, True):
+        eng = ServingEngine(tp, max_slots=2, page_size=8, int8=int8,
+                            use_paged_kernel=False)
+        rids = [eng.add_request(p, 8) for p in prompts]
+        out = eng.run()
+        if int8:
+            ref8 = _dense_greedy(single, prompts, 8, int8=True)
+            for i, rid in enumerate(rids):
+                np.testing.assert_array_equal(out[rid].tokens, ref8[i])
+        else:
+            for i, rid in enumerate(rids):
+                np.testing.assert_array_equal(out[rid].tokens, refs[i])
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching behavior
+# ---------------------------------------------------------------------------
+
+
+def test_engine_admits_into_freed_slot():
+    """More requests than slots: the engine must finish them ALL without
+    draining — a later request is admitted the step a slot frees."""
+    model = _model()
+    rng = np.random.RandomState(7)
+    prompts = _prompts(rng, (4, 4, 4, 4, 4))
+    eng = ServingEngine(model, max_slots=2, page_size=8)
+    rids = [eng.add_request(p, n) for p, n in
+            zip(prompts, (3, 9, 3, 5, 4))]
+    seen_busy = []
+    done = {}
+    while eng.has_work:
+        for fin in eng.step():
+            done[fin.rid] = fin
+        seen_busy.append(eng.scheduler.n_active)
+    assert set(done) == set(rids)
+    assert max(seen_busy) == 2  # both slots saturated
+    # short requests finished first despite FCFS admission: slot turnover
+    assert [len(done[r].tokens) for r in rids] == [3, 9, 3, 5, 4]
+    assert eng.pool.utilization() == 0.0  # everything freed
+    assert eng.scheduler.n_active == 0
+
+
+def test_engine_eos_frees_slot_and_pages():
+    """EOS mid-flight: the sequence stops, its pages return to the pool,
+    and a waiting request takes the slot."""
+    model = _model(seed=2)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, 512, (6,)).astype("int32")
+    # greedy continuation without EOS; pick its 3rd token as the EOS id
+    ref = _dense_greedy(model, [prompt], 10)[0]
+    eos = int(ref[2])
+    first_hit = int(np.argmax(ref == eos))
+    eng = ServingEngine(model, max_slots=1, page_size=8, eos_token_id=eos)
+    other = rng.randint(0, 512, (5,)).astype("int32")
+    r1 = eng.add_request(prompt, 10)
+    r2 = eng.add_request(other, 3)
+    out = eng.run()
+    assert out[r1].finish_reason == "eos"
+    assert len(out[r1].tokens) == first_hit + 1
+    assert out[r1].tokens[-1] == eos
+    np.testing.assert_array_equal(out[r1].tokens, ref[:first_hit + 1])
+    assert out[r2].finish_reason in ("length", "eos")
+    assert eng.pool.utilization() == 0.0
+    assert eng.scheduler.n_active == 0
+
+
+def test_generate_eos_masks_finished_rows():
+    """Static-batch early stop: after a row emits EOS every later position
+    is EOS, and pre-EOS tokens are untouched."""
+    model = _model(seed=2)
+    rng = np.random.RandomState(9)
+    ids = rng.randint(0, 512, (2, 6)).astype("int32")
+    ref = np.asarray(build_generate_fn(model, 10, greedy=True)(ids))
+    cont = ref[:, 6:]
+    eos = int(cont[0, 2])
+    out = np.asarray(build_generate_fn(model, 10, greedy=True,
+                                       eos_token_id=eos)(ids))
+    for b in range(2):
+        row, ref_row = out[b, 6:], cont[b]
+        hits = np.where(ref_row == eos)[0]
+        if hits.size:
+            j = int(hits[0])
+            np.testing.assert_array_equal(row[:j + 1], ref_row[:j + 1])
+            assert (row[j + 1:] == eos).all()
+        else:
+            np.testing.assert_array_equal(row, ref_row)
+
+
+def test_engine_rejects_oversized_request_on_every_path():
+    """Both admission paths (add_request AND run() with raw Requests) hit
+    the same max_seq_len gate — an over-long request can never be admitted
+    and then crash/corrupt mid-flight."""
+    model = _model()
+    eng = ServingEngine(model, max_slots=1, page_size=8)
+    long_prompt = np.arange(CFG["max_seq_len"] - 2, dtype=np.int32) % 512
+    with pytest.raises(ValueError):
+        eng.add_request(long_prompt, 8)
+    with pytest.raises(ValueError):
+        eng.run([Request(prompt=long_prompt, max_new_tokens=8)])
+
+
+def test_engine_pool_exhaustion_queues_instead_of_failing():
+    """A pool too small for two concurrent requests serializes them."""
+    model = _model()
+    rng = np.random.RandomState(11)
+    prompts = _prompts(rng, (8, 8))
+    # 5 usable pages of 8 = 40 tokens; each request needs 8+16=24 -> 3 pages
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=6)
+    refs = _dense_greedy(model, prompts, 16)
+    rids = [eng.add_request(p, 16) for p in prompts]
+    out = eng.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(out[rid].tokens, refs[i])
