@@ -9,3 +9,4 @@ interpreter fallback on CPU.
 
 from . import attention  # noqa: F401
 from . import paged_attention  # noqa: F401
+from . import paged_prefill  # noqa: F401

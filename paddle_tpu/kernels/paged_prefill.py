@@ -1,0 +1,210 @@
+"""Pallas TPU paged-prefill attention kernel (multi-query chunk, block-table KV).
+
+The chunked-prefill half of the serving engine (Sarathi-Serve, OSDI '24):
+where ``paged_attention.py`` answers "one new token per slot against its
+pages", this kernel answers "a CHUNK of a prompt's tokens against the
+pages already written — cached prefix pages, earlier chunks, and the
+chunk itself".  The engine writes the chunk's K/V into the slot's pages
+FIRST, so self-attention within the chunk arrives through the same page
+gather as the history and the kernel needs no separate in-chunk path.
+
+Design (pallas_guide.md, same skeleton as the decode kernel):
+
+  * grid = (pages,); the slot's block table and the chunk's global start
+    position ride in as SCALAR-PREFETCH args, so the K/V page of grid
+    step p is ``block_table[p]`` — the gather IS the BlockSpec index_map,
+    i.e. the DMA schedule;
+  * the whole (chunk, H, D) query block sits in VMEM across the page
+    grid; each page folds into a flash online-softmax recurrence with
+    per-query m/l/acc scratch.  CAUSALITY is the only mask: page position
+    j is visible to chunk row i iff ``j <= start + i`` — global position
+    0 is visible to every row, so no row is ever fully masked;
+  * int8 pages carry fp32 per-(position, head) scales dequantized in
+    VMEM right after the page DMA — the identical layout/decision as the
+    decode kernel and the dense int8 KV cache;
+  * ``interpret=True`` runs the identical body through the Pallas
+    interpreter and :func:`paged_prefill_ref` is the jnp oracle making
+    the same masking/dequant decisions — the parity contract
+    tests/test_serving.py asserts, which is what keeps chunked paged
+    prefill bit-comparable to the dense decoder's monolithic prefill.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash import _backend_is_tpu, _x64_off
+from .paged_attention import gather_pages
+
+_NEG_INF = -1e30
+
+
+def available() -> bool:
+    """Dispatch gate: True when the running backend executes Mosaic/Pallas
+    TPU kernels (tests monkeypatch this to force the kernel in interpret
+    mode)."""
+    return _backend_is_tpu()
+
+
+def supported(n_heads: int, page_size: int, head_dim: int,
+              chunk: int) -> bool:
+    """Shape gate for the fused kernel: lane-aligned head_dim, a
+    sublane-aligned page and chunk.  Ragged shapes take the jnp reference
+    path instead of failing at lowering."""
+    if head_dim % 128 != 0 or page_size % 32 != 0 or chunk % 8 != 0:
+        return False
+    # VMEM: q + acc (chunk, H, D) each, K/V pages (H, ps, D); vs 16MB/core
+    vmem = 4 * (2 * chunk * n_heads * head_dim
+                + 2 * n_heads * page_size * head_dim)
+    return vmem < 8 * 1024 * 1024
+
+
+def _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                      page_size, scale, chunk):
+    """The ONE online-softmax page step shared by the float and int8
+    entries (only how k/v materialize in VMEM differs): init scratch on
+    the first page, score + causal-mask this page against every chunk
+    row, fold into the m/l/acc flash recurrence, divide out on the last
+    page."""
+    p = pl.program_id(0)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[...].astype(jnp.float32)                     # (C, H, D)
+    s = jnp.einsum("chd,hsd->hcs", q, k,
+                   preferred_element_type=jnp.float32) * scale  # (H, C, ps)
+    pos = p * jnp.int32(page_size) + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, page_size), 2)
+    qpos = start_ref[0] + jax.lax.broadcasted_iota(
+        jnp.int32, (1, chunk, 1), 1)
+    s = jnp.where(pos <= qpos, s, jnp.float32(_NEG_INF))
+
+    m_prev = m_ref[...]                                    # (H, C)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new[:, :, None])
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=2)
+    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + jnp.einsum(
+        "hcs,hsd->hcd", pexp, v, preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(p == pl.num_programs(0) - 1)
+    def _finish():
+        out = acc_ref[...] / l_ref[...][:, :, None]        # (H, C, D)
+        o_ref[...] = jnp.einsum("hcd->chd", out).astype(o_ref.dtype)
+
+
+def _prefill_kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                    m_ref, l_ref, acc_ref, *, page_size, scale, chunk):
+    k = k_ref[0].astype(jnp.float32)                       # (H, ps, D)
+    v = v_ref[0].astype(jnp.float32)
+    _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                      page_size, scale, chunk)
+
+
+# the int8 entry has its own arity (scale refs) but the same recurrence
+def _prefill_kernel_int8(bt_ref, start_ref, q_ref, k_ref, ks_ref, v_ref,
+                         vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                         page_size, scale, chunk):
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (H, ps, D)
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+    _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                      page_size, scale, chunk)
+
+
+def paged_prefill(q, k_pages, v_pages, block_table, start, *,
+                  k_scales=None, v_scales=None, scale=None,
+                  interpret: bool | None = None):
+    """Chunk attention through a paged KV pool.
+
+    ``q`` (C, H, D) float — the chunk's queries, row i at global position
+    ``start + i``; ``k_pages``/``v_pages`` (P, H, page_size, D) float —
+    or int8 with ``k_scales``/``v_scales`` (P, H, page_size, 1) fp32;
+    ``block_table`` (max_pages,) int32 page ids for THIS slot (padding
+    entries must reference a valid page — the pool's null page 0);
+    ``start`` scalar int32 positions already valid before the chunk.  The
+    chunk's own K/V must ALREADY be written into the pages.  Returns
+    (C, H, D) in q.dtype.  Callers gate on :func:`available` /
+    :func:`supported` first.
+    """
+    c, h, d = q.shape
+    _, _, ps, _ = k_pages.shape
+    max_pages = block_table.shape[0]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    scale = np.float32(scale)
+    if interpret is None:
+        interpret = not _backend_is_tpu()
+    int8 = k_scales is not None
+
+    q_spec = pl.BlockSpec((c, h, d), lambda p, bt, st: (0, 0, 0))
+    pg_spec = pl.BlockSpec((1, h, ps, d), lambda p, bt, st: (bt[p], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, h, ps, 1), lambda p, bt, st: (bt[p], 0, 0, 0))
+    if int8:
+        kernel = functools.partial(_prefill_kernel_int8, page_size=ps,
+                                   scale=scale, chunk=c)
+        in_specs = [q_spec, pg_spec, sc_spec, pg_spec, sc_spec]
+        args = (q, k_pages, k_scales, v_pages, v_scales)
+    else:
+        kernel = functools.partial(_prefill_kernel, page_size=ps,
+                                   scale=scale, chunk=c)
+        in_specs = [q_spec, pg_spec, pg_spec]
+        args = (q, k_pages, v_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(max_pages,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((c, h, d), lambda p, bt, st: (0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((h, c), jnp.float32),     # running max
+                        pltpu.VMEM((h, c), jnp.float32),     # running denom
+                        pltpu.VMEM((h, c, d), jnp.float32)],  # weighted acc
+    )
+    with _x64_off():
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((c, h, d), q.dtype),
+            interpret=interpret,
+        )(block_table.astype(jnp.int32),
+          jnp.asarray(start, jnp.int32).reshape(1), *args)
+
+
+def paged_prefill_ref(q, k_pages, v_pages, block_table, start, *,
+                      k_scales=None, v_scales=None, scale=None):
+    """jnp reference path: gathers this slot's pages dense and runs the
+    EXACT einsum/mask/softmax sequence of the dense prefill
+    (models/generation._block_fwd) with the same causal rule
+    ``page_pos <= start + row``, so a chunked paged prefill is
+    bit-comparable to the monolithic dense prefill — the CPU fallback and
+    the kernel's parity oracle."""
+    c, h, d = q.shape
+    ps = k_pages.shape[2]
+    s_max = block_table.shape[0] * ps
+    k_eff = gather_pages(k_pages, block_table[None], k_scales)[0]  # (H,S,D)
+    v_eff = gather_pages(v_pages, block_table[None], v_scales)[0]
+    s = jnp.einsum("chd,hsd->hcs", q, k_eff,
+                   preferred_element_type=jnp.float32)
+    if scale is None:
+        # divide, exactly as the dense decoder scales its scores — keeps
+        # the two prefill substrates bit-comparable, not just close
+        s = s / np.sqrt(d).astype(np.float32)
+    else:
+        s = s * jnp.float32(scale)
+    pos = jnp.arange(s_max, dtype=jnp.int32)[None, None, :]
+    qpos = start + jnp.arange(c, dtype=jnp.int32)[None, :, None]
+    s = jnp.where(pos <= qpos, s, _NEG_INF)
+    att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
+    out = jnp.einsum("hcs,hsd->chd", att, v_eff)
+    return out.astype(q.dtype)

@@ -123,6 +123,14 @@ def _block_qkv(p, x, n_heads, eps, seq_major=False):
     return q, k_blk, v_blk
 
 
+def _lm_head(p, x, eps):
+    """Final LN + tied-embedding projection to fp32 logits over the last
+    axis of ``x``.  Shared by the dense decoder and the serving engine's
+    chunk-prefill/decode programs so the logits math cannot fork."""
+    h = _ln(x, p["lnf_g"], p["lnf_b"], eps)
+    return (h @ p["wte"].T).astype(jnp.float32)
+
+
 def _block_finish(p, x, out, eps):
     """The block's post-attention half: output projection residual + MLP
     residual.  ``out`` is the attention output already merged back to the
@@ -218,8 +226,7 @@ def _decoder_setup(model, int8=None):
 
     def make_run(p):
         def logits_from(x):
-            x = _ln(x, p["lnf_g"], p["lnf_b"], eps)
-            return (x @ p["wte"].T).astype(jnp.float32)
+            return _lm_head(p, x, eps)
 
         def run(tokens, pos, kc, vc):
             t = tokens.shape[1]

@@ -1,24 +1,32 @@
-"""Continuous-batching serving subsystem (ISSUE r08 tentpole).
+"""Continuous-batching serving subsystem (ISSUE r08 tentpole, r09 prefix
+caching + chunked prefill).
 
-Composes three pieces:
+Composes four pieces:
 
   * :class:`~paddle_tpu.serving.kv_pool.KVPool` — page-pool KV cache
-    allocator with a reserved null page (PagedAttention, SOSP '23);
+    allocator with a reserved null page and per-page refcounts
+    (PagedAttention, SOSP '23);
+  * :class:`~paddle_tpu.serving.prefix_cache.PrefixIndex` — page-aligned
+    radix index over token chunks for KV page reuse across requests
+    sharing a prompt prefix, with LRU eviction of reclaimable pages
+    (RadixAttention / SGLang);
   * :class:`~paddle_tpu.serving.scheduler.FCFSScheduler` — FCFS
-    iteration-level admission with a per-step token budget (Orca,
-    OSDI '22);
+    iteration-level admission with a Sarathi-style per-step chunk budget
+    (Orca, OSDI '22; Sarathi-Serve, OSDI '24);
   * :class:`~paddle_tpu.serving.engine.ServingEngine` — the host loop
-    over TWO reusable jitted programs (bucketed prefill-into-slot +
+    over TWO reusable jitted programs (chunked prefill-into-pages +
     single decode step over the slot batch), backed by the Pallas
-    paged-attention kernel (kernels/paged_attention.py).
+    paged-attention decode and paged-prefill chunk kernels
+    (kernels/paged_attention.py, kernels/paged_prefill.py).
 
 See README "Serving" for the architecture and knobs;
 ``examples/serve_gpt.py`` for the end-to-end loop.
 """
 
 from .kv_pool import KVPool
+from .prefix_cache import PrefixIndex
 from .scheduler import Admission, FCFSScheduler, Request
 from .engine import FinishedRequest, ServingEngine
 
-__all__ = ["KVPool", "FCFSScheduler", "Request", "Admission",
+__all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "ServingEngine", "FinishedRequest"]

@@ -7,31 +7,46 @@ request ends, and a new request cannot join until the whole batch
 drains.  This engine instead runs serving as TWO reusable jitted
 programs called from a host loop:
 
-  * ``prefill``: one request's prompt through the model's existing dense
-    prefill (``_decoder_setup``'s ``make_run`` — the SAME substrate the
-    static decoder compiles, so the numerics cannot fork), its KV
-    scattered into the slot's pool pages, first token sampled.  Prompt
-    lengths are padded to power-of-two buckets so the program retraces
-    per bucket, not per length.
-  * ``decode``: ONE token for EVERY occupied slot — embedding,
-    ``_block_qkv``, per-slot paged KV write at each slot's own position,
-    paged attention through the block table (Pallas kernel on TPU, jnp
-    reference elsewhere — kernels/paged_attention.py), ``_block_finish``,
-    sampling.  Slot count is static; inactive lanes compute into the
-    pool's null page and are ignored.
+  * ``chunk prefill``: up to ``chunk_tokens`` of ONE request's prompt
+    per call — embeddings, ``_block_qkv``, the chunk's K/V scattered
+    into the slot's pool pages, then paged attention of the chunk
+    against everything already written (cached prefix pages, earlier
+    chunks, itself) via the block table — the Sarathi-Serve chunked
+    prefill (kernels/paged_prefill.py).  Chunk widths pad to power-of-two
+    buckets so the program retraces per bucket, not per length.  A long
+    prompt no longer stalls every in-flight decode for a monolithic
+    prefill: each step spends at most the scheduler's chunk budget on
+    prefill, co-scheduled with decode.
+  * ``decode``: ONE token for EVERY started slot — per-slot paged KV
+    write at each slot's own position, paged attention through the block
+    table (kernels/paged_attention.py), sampling.  Slot count is static;
+    inactive/partially-prefilled lanes compute into the pool's null page
+    and are ignored.
+
+Prefix caching (RadixAttention, SGLang) rides on the page pool: at
+admission the scheduler matches the prompt against the pool's
+token-chunk radix index, the request's block table starts with the
+matched pages SHARED (refcounted, read-only), a partial-tail match is
+COPY-ON-WRITE cloned into a fresh page, and only the uncached suffix is
+chunk-prefilled.  When a prompt finishes prefilling, its full pages are
+inserted into the index; a finished request's pages drop their reference
+and cached pages park reclaimable (LRU-evicted under pressure) instead
+of being eagerly freed — a shared system prompt is computed once and
+reused by every later request.
 
 Every host-loop iteration the FCFS scheduler admits waiting requests
-into freed slots (per-step token budget), runs at most a handful of
-prefill calls plus exactly one decode call, and returns finished
-requests — iteration-level scheduling (Orca) with block-table paging
-(vLLM), composed with the int8 W8A8 + int8-KV serving path from the
-dense decoder: the per-(layer, batch, head, position) scale layout
-carries over to per-page scales unchanged.
+into freed slots, the chunk budget advances partial prefills, exactly
+one decode call covers the started slots, and finished requests return —
+iteration-level scheduling (Orca) with block-table paging (vLLM),
+composed with the int8 W8A8 + int8-KV serving path from the dense
+decoder: the per-(layer, batch, head, position) scale layout carries
+over to per-page scales unchanged.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -43,11 +58,11 @@ from ..models.generation import (
     _block_finish,
     _block_qkv,
     _decoder_setup,
-    _empty_cache,
-    _ln,
+    _lm_head,
     _make_sampler,
 )
 from ..kernels import paged_attention as pa
+from ..kernels import paged_prefill as pp
 from .kv_pool import KVPool
 from .scheduler import FCFSScheduler, Request
 
@@ -73,11 +88,15 @@ def _next_pow2(n: int) -> int:
 class _Slot:
     """Host-side state of one occupied engine slot."""
 
-    def __init__(self, request: Request, pages: List[int]):
+    def __init__(self, request: Request, pages: List[int], prefilled: int,
+                 seq: int):
         self.request = request
-        self.pages = pages
+        self.pages = pages            # table order: shared prefix + owned
         self.tokens: List[int] = []
         self.born_step = 0
+        self.seq = seq                # admission order (FCFS chunk budget)
+        self.prefilled = prefilled    # prompt positions with K/V in pages
+        self.started = False          # first token sampled; decoding
 
 
 class ServingEngine:
@@ -86,10 +105,14 @@ class ServingEngine:
     ``max_slots`` bounds the decode batch (the step's static shape);
     ``page_size`` the pool granularity; ``num_pages`` the pool size
     (default: enough for every slot at ``max_seq_len``, +1 null page);
-    ``token_budget`` the scheduler's per-step admission budget.  Sampling
-    knobs mirror ``build_generate_fn``; ``int8`` serves W8A8 projections
-    + int8 KV pages.  ``use_paged_kernel`` forces the Pallas kernel (or
-    the jnp reference) instead of auto-dispatch — tests use it to pin the
+    ``token_budget`` the scheduler's per-step token budget (decode tokens
+    + prefill chunk); ``chunk_tokens`` the chunk-prefill program width —
+    prompts longer than a step's chunk budget prefill across steps,
+    co-scheduled with decode; ``prefix_cache`` reuses KV pages across
+    requests sharing a page-aligned token prefix.  Sampling knobs mirror
+    ``build_generate_fn``; ``int8`` serves W8A8 projections + int8 KV
+    pages.  ``use_paged_kernel`` forces the Pallas kernels (or the jnp
+    references) instead of auto-dispatch — tests use it to pin the
     interpret-mode kernel path on CPU.
     """
 
@@ -102,7 +125,8 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None,
                  int8: Optional[bool] = None, seed: int = 0,
                  decode_block: int = 1,
-                 use_paged_kernel: Optional[bool] = None):
+                 use_paged_kernel: Optional[bool] = None,
+                 chunk_tokens: int = 128, prefix_cache: bool = True):
         cfg = model.cfg
         self.cfg = cfg
         # decode_block > 1 fuses that many decode steps into ONE dispatched
@@ -112,8 +136,7 @@ class ServingEngine:
         # once per block instead of once per token.  1 = pure
         # admit-every-step continuous batching (the parity-test mode).
         self.decode_block = max(1, int(decode_block))
-        self.params, self._make_run, self.int8 = _decoder_setup(
-            model, int8=int8)
+        self.params, _, self.int8 = _decoder_setup(model, int8=int8)
         self.n_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.eps = cfg.layer_norm_eps
@@ -124,17 +147,23 @@ class ServingEngine:
             raise ValueError("max_seq_len exceeds the model's position table")
         self.max_pages = -(-self.max_seq_len // page_size)
         self.eos_token_id = eos_token_id
+        self.chunk_tokens = max(1, min(int(chunk_tokens), self.max_seq_len))
         dtype = self.params["wte"].dtype
         n_pages = num_pages or (1 + max_slots * self.max_pages)
         self.pool = KVPool(cfg.num_layers, cfg.num_heads, self.head_dim,
-                           n_pages, page_size, dtype=dtype, int8=self.int8)
+                           n_pages, page_size, dtype=dtype, int8=self.int8,
+                           prefix_cache=prefix_cache)
         self.scheduler = FCFSScheduler(max_slots, self.pool,
                                        token_budget=token_budget)
         self._sample = _make_sampler(greedy, temperature, top_k, top_p)
         if use_paged_kernel is None:
-            use_paged_kernel = pa.available() and pa.supported(
+            self._use_kernel = pa.available() and pa.supported(
                 cfg.num_heads, page_size, self.head_dim)
-        self._use_kernel = bool(use_paged_kernel)
+            self._use_prefill_kernel = pp.available() and pp.supported(
+                cfg.num_heads, page_size, self.head_dim, self.chunk_tokens)
+        else:
+            self._use_kernel = bool(use_paged_kernel)
+            self._use_prefill_kernel = bool(use_paged_kernel)
 
         # host mirrors of the decode step's device operands
         self._slots: List[Optional[_Slot]] = [None] * max_slots
@@ -143,16 +172,21 @@ class ServingEngine:
         self._table = np.zeros((max_slots, self.max_pages), np.int32)
         self._key = jax.random.PRNGKey(seed)
         self._step_idx = 0
+        self._admit_seq = 0
         self.stats = {"prefill_calls": 0, "decode_calls": 0,
                       "prefill_traces": 0, "decode_traces": 0,
-                      "tokens_generated": 0}
+                      "tokens_generated": 0,
+                      "prefix_hit_tokens": 0, "prompt_tokens": 0,
+                      "pages_in_use": 0, "queue_depth": 0,
+                      "step_wall_s": 0.0, "last_step_s": 0.0}
         self._decode_fn = self._build_decode()
         self._prefill_fn = self._build_prefill()
+        self._cow_fn = self._build_cow()
 
     # -- device programs --------------------------------------------------
 
     def _attend(self, q, bufs, li, table, lengths):
-        """Paged attention for layer ``li`` — kernel or jnp reference."""
+        """Paged decode attention for layer ``li`` — kernel or jnp ref."""
         if self.int8:
             kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
         else:
@@ -160,14 +194,42 @@ class ServingEngine:
         fn = pa.paged_attention if self._use_kernel else pa.paged_attention_ref
         return fn(q, bufs["k"][li], bufs["v"][li], table, lengths, **kw)
 
+    def _attend_prefill(self, q, bufs, li, table_row, start):
+        """Paged chunk attention for layer ``li`` — kernel or jnp ref."""
+        if self.int8:
+            kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
+        else:
+            kw = {}
+        fn = (pp.paged_prefill if self._use_prefill_kernel
+              else pp.paged_prefill_ref)
+        return fn(q, bufs["k"][li], bufs["v"][li], table_row, start, **kw)
+
+    def _scatter_kv(self, bufs, li, rows, offs, k1, v1):
+        """Write per-token K/V (rows of shape (N, H, D)) into layer ``li``
+        of the page pool at (page ``rows[i]``, offset ``offs[i]``) —
+        quantizing to int8 pages + fp32 per-token scales when serving
+        int8.  The ONE scatter/quantize sequence shared by the decode and
+        chunk-prefill programs, so the exact-parity contract cannot fork
+        between them."""
+        if self.int8:
+            from ..ops.quant_ops import quantize_per_token
+
+            kq, ksc = quantize_per_token(k1)
+            vq, vsc = quantize_per_token(v1)
+            bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(kq)
+            bufs["ks"] = bufs["ks"].at[li, rows, :, offs, :].set(ksc)
+            bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(vq)
+            bufs["vs"] = bufs["vs"].at[li, rows, :, offs, :].set(vsc)
+        else:
+            bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(k1)
+            bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(v1)
+        return bufs
+
     def _build_decode(self):
-        n_heads, eps, ps, int8 = (self.n_heads, self.eps, self.page_size,
-                                  self.int8)
+        n_heads, eps, ps = self.n_heads, self.eps, self.page_size
         maxp, k_steps = self.max_pages, self.decode_block
 
         def one_step(p, bufs, table, toks, lengths, active, key):
-            from ..ops.quant_ops import quantize_per_token
-
             s = toks.shape[0]
             x = (p["wte"][toks] + p["wpe"][lengths])[:, None, :]  # (S, 1, h)
             page_idx = jnp.minimum(lengths // ps, maxp - 1)
@@ -177,21 +239,11 @@ class ServingEngine:
             for li, bp in enumerate(p["blocks"]):
                 q, kb, vb = _block_qkv(bp, x, n_heads, eps)
                 q1, k1, v1 = q[:, :, 0], kb[:, :, 0], vb[:, :, 0]  # (S, H, D)
-                if int8:
-                    kq, ksc = quantize_per_token(k1)
-                    vq, vsc = quantize_per_token(v1)
-                    bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(kq)
-                    bufs["ks"] = bufs["ks"].at[li, rows, :, offs, :].set(ksc)
-                    bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(vq)
-                    bufs["vs"] = bufs["vs"].at[li, rows, :, offs, :].set(vsc)
-                else:
-                    bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(k1)
-                    bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(v1)
+                bufs = self._scatter_kv(bufs, li, rows, offs, k1, v1)
                 out = self._attend(q1, bufs, li, table, lengths + 1)
                 out = out.reshape(s, -1)[:, None, :].astype(x.dtype)
                 x = _block_finish(bp, x, out, eps)
-            h = _ln(x[:, 0], p["lnf_g"], p["lnf_b"], eps)
-            logits = (h @ p["wte"].T).astype(jnp.float32)          # (S, V)
+            logits = _lm_head(p, x[:, 0], eps)                    # (S, V)
             key, sub = jax.random.split(key)
             return bufs, self._sample(logits, sub).astype(jnp.int32)
 
@@ -222,39 +274,57 @@ class ServingEngine:
         return jax.jit(decode, donate_argnums=(1,))
 
     def _build_prefill(self):
-        cfg, ps, int8 = self.cfg, self.page_size, self.int8
+        n_heads, eps, ps = self.n_heads, self.eps, self.page_size
+        maxp = self.max_pages
 
-        def prefill(p, bufs, tokens, length, table_row, key):
+        def prefill(p, bufs, toks, start, n_valid, table_row, sample_idx,
+                    key):
+            """One chunk of one prompt: rows [start, start+n_valid) of the
+            sequence.  Writes the chunk's K/V into the slot's pages, then
+            attends the chunk against every already-written position (the
+            cached/previous pages AND itself) through the block table.
+            ``sample_idx`` is the chunk row holding the LAST prompt token;
+            its sample is used only by the chunk that completes the
+            prompt."""
             self.stats["prefill_traces"] += 1
-            run = self._make_run(p)
-            t_pad = tokens.shape[1]
-            kc, vc = _empty_cache(cfg, 1, t_pad, p["wte"].dtype, int8=int8)
-            logits, kc, vc = run(tokens, 0, kc, vc)
-            pos = jnp.arange(t_pad, dtype=jnp.int32)
-            # padded positions scatter into the null page (page 0)
-            pages = jnp.where(pos < length, table_row[pos // ps], 0)
+            c = toks.shape[0]
+            pos = start + jnp.arange(c, dtype=jnp.int32)
+            x = (p["wte"][toks] + p["wpe"][pos])[None]        # (1, C, h)
+            # padded rows scatter into the null page (page 0)
+            valid = jnp.arange(c) < n_valid
+            page_idx = jnp.minimum(pos // ps, maxp - 1)
+            rows = jnp.where(valid, table_row[page_idx], 0)
             offs = pos % ps
-
-            def scatter(buf, blk):
-                # blk (L, 1, H, T_pad, D|1) -> advanced-index layout
-                # (T_pad, L, H, D|1) for the (page, off) scatter
-                val = jnp.einsum("lbhtd->tlhd", blk)
-                return buf.at[:, pages, :, offs, :].set(val)
-
-            if int8:
-                bufs = dict(bufs, k=scatter(bufs["k"], kc[0]),
-                            ks=scatter(bufs["ks"], kc[1]),
-                            v=scatter(bufs["v"], vc[0]),
-                            vs=scatter(bufs["vs"], vc[1]))
-            else:
-                bufs = dict(bufs, k=scatter(bufs["k"], kc),
-                            v=scatter(bufs["v"], vc))
-            last = jnp.take(logits[0], length - 1, axis=0)         # (V,)
+            for li, bp in enumerate(p["blocks"]):
+                q, kb, vb = _block_qkv(bp, x, n_heads, eps)
+                # (1, H, C, D) -> (C, H, D): the page-scatter layout
+                q1 = jnp.swapaxes(q[0], 0, 1)
+                k1 = jnp.swapaxes(kb[0], 0, 1)
+                v1 = jnp.swapaxes(vb[0], 0, 1)
+                bufs = self._scatter_kv(bufs, li, rows, offs, k1, v1)
+                out = self._attend_prefill(q1, bufs, li, table_row, start)
+                out = out.reshape(c, -1)[None].astype(x.dtype)
+                x = _block_finish(bp, x, out, eps)
+            # only the sample row's logits are ever consumed (and only by
+            # the chunk completing the prompt): project ONE row, not the
+            # whole (C, V) chunk — LN + matmul are row-wise, so the
+            # sampled logits are bit-identical to the full projection
+            h_row = jnp.take(x[0], sample_idx, axis=0)        # (h,)
+            last = _lm_head(p, h_row[None, :], eps)           # (1, V)
             key, sub = jax.random.split(key)
-            tok = self._sample(last[None, :], sub)[0]
+            tok = self._sample(last, sub)[0]
             return bufs, tok.astype(jnp.int32)
 
         return jax.jit(prefill, donate_argnums=(1,))
+
+    def _build_cow(self):
+        def cow(bufs, src, dst):
+            """Copy-on-write clone of one pool page across all layers —
+            the partial-tail prefix match: the new owner will overwrite
+            positions past the matched count and decode masks the rest."""
+            return {k: b.at[:, dst].set(b[:, src]) for k, b in bufs.items()}
+
+        return jax.jit(cow, donate_argnums=(0,))
 
     # -- public API -------------------------------------------------------
 
@@ -280,6 +350,11 @@ class ServingEngine:
     def has_work(self) -> bool:
         return self.scheduler.has_work
 
+    def prefix_hit_rate(self) -> float:
+        """Fraction of prompt tokens served from cached KV pages."""
+        return self.stats["prefix_hit_tokens"] / max(
+            self.stats["prompt_tokens"], 1)
+
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
@@ -296,40 +371,95 @@ class ServingEngine:
             tokens=np.asarray(st.tokens, np.int32), finish_reason=reason,
             n_steps=self._step_idx - st.born_step + 1)
 
+    def _admit(self, adm) -> None:
+        """Apply one scheduling decision: build the slot's block table
+        from shared-prefix + owned pages, clone the COW tail page, record
+        how much of the prompt needs no recompute."""
+        req, idx = adm.request, adm.slot
+        pages = list(adm.cached) + list(adm.pages)
+        if adm.cow is not None:
+            src, _ = adm.cow
+            # the first owned page inherits the partial tail's K/V; the
+            # source page drops the reference the scheduler pinned for us
+            self.pool.buffers = self._cow_fn(
+                self.pool.buffers, jnp.int32(src), jnp.int32(adm.pages[0]))
+            self.pool.release([src])
+        self._admit_seq += 1
+        st = _Slot(req, pages, prefilled=adm.matched, seq=self._admit_seq)
+        st.born_step = self._step_idx
+        self._slots[idx] = st
+        row = np.zeros((self.max_pages,), np.int32)
+        row[:len(pages)] = pages
+        self._table[idx] = row
+        self.stats["prefix_hit_tokens"] += adm.matched
+        self.stats["prompt_tokens"] += req.prompt_len
+
+    def _prefill_chunks(self, finished: List[FinishedRequest]) -> None:
+        """Spend the step's chunk budget FCFS over partially-prefilled
+        slots: at most ``prefill_budget`` prompt tokens total, each call
+        one chunk of one slot's prompt.  A slot whose prompt completes
+        samples its first token and joins this step's decode batch."""
+        n_decoding = sum(1 for s in self._slots
+                         if s is not None and s.started)
+        budget = self.scheduler.prefill_budget(n_decoding, self.chunk_tokens)
+        partial = sorted(
+            (i for i, s in enumerate(self._slots)
+             if s is not None and not s.started),
+            key=lambda i: self._slots[i].seq)
+        for idx in partial:
+            st = self._slots[idx]
+            while budget > 0 and not st.started:
+                n = min(st.request.prompt_len - st.prefilled, budget,
+                        self.chunk_tokens)
+                c_pad = min(_next_pow2(max(n, 8)),
+                            max(self.chunk_tokens, n))
+                toks = np.zeros((c_pad,), np.int32)
+                toks[:n] = st.request.prompt[st.prefilled:st.prefilled + n]
+                self.pool.buffers, tok = self._prefill_fn(
+                    self.params, self.pool.buffers, jnp.asarray(toks),
+                    jnp.int32(st.prefilled), jnp.int32(n),
+                    jnp.asarray(self._table[idx]), jnp.int32(n - 1),
+                    self._next_key())
+                self.stats["prefill_calls"] += 1
+                st.prefilled += n
+                budget -= n
+                if st.prefilled < st.request.prompt_len:
+                    continue
+                # prompt complete: first token sampled; its full pages
+                # become matchable for every later request
+                st.started = True
+                if self.pool.prefix is not None:
+                    nfull = st.request.prompt_len // self.page_size
+                    self.pool.prefix.insert(st.request.prompt,
+                                            st.pages[:nfull])
+                tok = int(tok)
+                st.tokens.append(tok)
+                self.stats["tokens_generated"] += 1
+                self._tok[idx] = tok
+                self._len[idx] = st.request.prompt_len
+                if (self.eos_token_id is not None
+                        and tok == self.eos_token_id):
+                    finished.append(self._finish(idx, "eos"))
+                elif len(st.tokens) >= st.request.max_new_tokens:
+                    finished.append(self._finish(idx, "length"))
+            if budget <= 0:
+                break
+
     def step(self) -> List[FinishedRequest]:
-        """One engine iteration: admit into freed slots (prefill), then one
-        decode step over every occupied slot.  Returns requests that
-        finished this step (EOS or length)."""
+        """One engine iteration: admit into freed slots, advance partial
+        prefills by the chunk budget, then one decode step over every
+        started slot.  Returns requests that finished this step (EOS or
+        length)."""
+        t0 = time.perf_counter()
         finished: List[FinishedRequest] = []
         self._step_idx += 1
 
         for adm in self.scheduler.schedule_step():
-            req, idx = adm.request, adm.slot
-            st = _Slot(req, adm.pages)
-            st.born_step = self._step_idx
-            self._slots[idx] = st
-            row = np.zeros((self.max_pages,), np.int32)
-            row[:len(adm.pages)] = adm.pages
-            self._table[idx] = row
-            t_pad = min(_next_pow2(max(req.prompt_len, 8)), self.max_seq_len)
-            tokens = np.zeros((1, t_pad), np.int32)
-            tokens[0, :req.prompt_len] = req.prompt
-            self.pool.buffers, tok = self._prefill_fn(
-                self.params, self.pool.buffers, jnp.asarray(tokens),
-                jnp.int32(req.prompt_len), jnp.asarray(row),
-                self._next_key())
-            self.stats["prefill_calls"] += 1
-            tok = int(tok)
-            st.tokens.append(tok)
-            self.stats["tokens_generated"] += 1
-            self._tok[idx] = tok
-            self._len[idx] = req.prompt_len
-            if self.eos_token_id is not None and tok == self.eos_token_id:
-                finished.append(self._finish(idx, "eos"))
-            elif len(st.tokens) >= req.max_new_tokens:
-                finished.append(self._finish(idx, "length"))
+            self._admit(adm)
+        self._prefill_chunks(finished)
 
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and s.started]
         if active:
             remaining = np.zeros((self.max_slots,), np.int32)
             for idx in active:
@@ -363,7 +493,25 @@ class ServingEngine:
                     # and its carry token is the last sampled one
                     self._tok[idx] = int(toks_all[consumed - 1, idx])
                     self._len[idx] += consumed
+        dt = time.perf_counter() - t0
+        self.stats["pages_in_use"] = self.pool.pages_in_use
+        self.stats["queue_depth"] = self.scheduler.n_waiting
+        self.stats["step_wall_s"] += dt
+        self.stats["last_step_s"] = dt
         return finished
+
+    def check_invariants(self) -> None:
+        """Page-leak / refcount-consistency audit: the pool's internal
+        bookkeeping must balance, and the refcount total must equal the
+        page references live slots actually hold.  The serving tests'
+        conftest fixture calls this after every step."""
+        self.pool.check()
+        refs = sum(len(s.pages) for s in self._slots if s is not None)
+        held = sum(self.pool.refcount)
+        if held != refs:
+            raise AssertionError(
+                f"refcount sum {held} != {refs} page references held by "
+                "live slots — a page reference leaked or double-freed")
 
     def run(self, requests: Optional[Sequence] = None
             ) -> Dict[int, FinishedRequest]:
@@ -379,4 +527,11 @@ class ServingEngine:
         while self.has_work:
             for fin in self.step():
                 done[fin.rid] = fin
+        # teardown: with every request finished the pool must be back at
+        # the cached-prefix-only baseline — any page still referenced by
+        # a live slot (there are none) is a leak
+        if self.scheduler.n_active or self.pool.pages_in_use:
+            raise AssertionError(
+                f"page leak after drain: {self.scheduler.n_active} slots "
+                f"active, {self.pool.pages_in_use} pages still referenced")
         return done

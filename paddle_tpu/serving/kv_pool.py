@@ -22,25 +22,36 @@ Page 0 is RESERVED as the null page: the allocator never hands it out,
 block-table padding points at it, and masked/inactive lanes write their
 garbage there — so no gather in the paged-attention kernel can ever
 index out of the pool, and no active page can be corrupted by an
-inactive lane.  Allocation itself is a host-side free list (LIFO for
-locality); the device arrays are threaded functionally through the
-engine's jitted programs and donated back each step.
+inactive lane.
+
+Sharing (r09): every page carries a REFCOUNT of live requests holding it.
+``alloc`` leases fresh pages at refcount 1; a request matching a cached
+prefix ``retain``\\ s the shared pages (+1 each); ``free`` drops one
+reference per page and only a page at refcount 0 actually leaves
+circulation — back to the free list, unless the pool's
+:class:`~paddle_tpu.serving.prefix_cache.PrefixIndex` still names it, in
+which case it parks as *reclaimable* (its K/V stay matchable) until LRU
+eviction hands it back under pressure.  The free list is mirrored by a
+set so alloc/free/double-free checks are all O(1) per page.
 """
 
 from __future__ import annotations
 
 import math
+from collections import Counter
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 
+from .prefix_cache import PrefixIndex
+
 
 class KVPool:
-    """Fixed-size page pool + free-list allocator for the serving engine."""
+    """Fixed-size page pool + refcounted free-list allocator."""
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_pages: int, page_size: int, dtype=jnp.float32,
-                 int8: bool = False):
+                 int8: bool = False, prefix_cache: bool = False):
         if num_pages < 2:
             raise ValueError("KVPool needs >= 2 pages (page 0 is the "
                              "reserved null page)")
@@ -61,8 +72,13 @@ class KVPool:
         else:
             self.buffers = {"k": jnp.zeros(shape, dtype),
                             "v": jnp.zeros(shape, dtype)}
-        # LIFO free list over pages 1..P-1; page 0 stays the null page
+        # LIFO free list over pages 1..P-1 (page 0 stays the null page),
+        # mirrored by a set for O(1) membership
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+        self.refcount: List[int] = [0] * num_pages
+        self.prefix: Optional[PrefixIndex] = (
+            PrefixIndex(page_size) if prefix_cache else None)
 
     # -- allocation -------------------------------------------------------
 
@@ -70,31 +86,111 @@ class KVPool:
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_cached(self) -> int:
+        """Pages parked in the prefix index (reclaimable + shared)."""
+        return len(self.prefix) if self.prefix is not None else 0
+
+    @property
+    def num_reclaimable(self) -> int:
+        """Cached pages with no live reference — evictable on demand."""
+        if self.prefix is None:
+            return 0
+        return sum(1 for p in self.prefix._by_page if self.refcount[p] == 0)
+
+    @property
+    def pages_in_use(self) -> int:
+        """Pages referenced by at least one live request."""
+        return sum(1 for r in self.refcount if r > 0)
+
     def pages_for(self, n_tokens: int) -> int:
         """Pages needed to hold ``n_tokens`` positions."""
         return max(1, math.ceil(n_tokens / self.page_size))
 
+    def _check_page(self, p: int) -> None:
+        if p <= 0 or p >= self.num_pages:
+            raise ValueError(f"invalid page id {p}")
+
+    def _push_free(self, p: int) -> None:
+        self._free.append(p)
+        self._free_set.add(p)
+
     def alloc(self, n_pages: int) -> Optional[List[int]]:
-        """Pop ``n_pages`` from the free list, or None when the pool can't
-        satisfy the request (caller keeps the request queued — FCFS)."""
+        """Lease ``n_pages`` fresh pages at refcount 1, or None when even
+        LRU-evicting reclaimable cached pages can't satisfy the request
+        (caller keeps the request queued — FCFS)."""
+        if n_pages == 0:
+            return []
+        if n_pages > len(self._free) and self.prefix is not None:
+            for p in self.prefix.evict(n_pages - len(self._free),
+                                       self.refcount):
+                self._push_free(p)
         if n_pages > len(self._free):
             return None
-        got = [self._free.pop() for _ in range(n_pages)]
+        got = []
+        for _ in range(n_pages):
+            p = self._free.pop()
+            self._free_set.discard(p)
+            self.refcount[p] = 1
+            got.append(p)
         return got
 
-    def free(self, pages: List[int]) -> None:
-        """Return a finished request's pages.  Double-free and null-page
-        free are programming errors worth failing loudly on."""
+    def retain(self, pages: List[int]) -> None:
+        """Add one reference per page — a request adopting cached prefix
+        pages (a reclaimable page at refcount 0 becomes live again)."""
         for p in pages:
-            if p <= 0 or p >= self.num_pages:
-                raise ValueError(f"free of invalid page id {p}")
-            if p in self._free:
+            self._check_page(p)
+            if p in self._free_set:
+                raise ValueError(f"retain of free page {p}")
+            self.refcount[p] += 1
+
+    def free(self, pages: List[int]) -> None:
+        """Drop one reference per page.  A page reaching refcount 0 goes
+        back to the free list unless the prefix index still names it (it
+        parks as reclaimable instead).  Over-freeing — more drops than
+        references, including duplicates within one call — is a
+        programming error worth failing loudly on, BEFORE any mutation."""
+        for p, n in Counter(pages).items():
+            self._check_page(p)
+            if self.refcount[p] < n:
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(reversed(pages))
+        for p in pages:
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0 and not (
+                    self.prefix is not None and p in self.prefix):
+                self._push_free(p)
+
+    # retain/free bracket one REFERENCE; `release` reads better at call
+    # sites that drop a whole lease
+    release = free
+
+    # -- invariants -------------------------------------------------------
+
+    def check(self) -> None:
+        """Refcount / free-list / prefix-index consistency — every page is
+        exactly one of: free, live (refcount > 0), or cached-reclaimable.
+        The serving tests' leak fixture calls this after every step."""
+        if len(self._free) != len(self._free_set) or \
+                set(self._free) != self._free_set:
+            raise AssertionError("free list and free set diverged")
+        if 0 in self._free_set or self.refcount[0] != 0:
+            raise AssertionError("null page entered circulation")
+        cached = set(self.prefix._by_page) if self.prefix is not None else set()
+        for p in range(1, self.num_pages):
+            free = p in self._free_set
+            rc = self.refcount[p]
+            if rc < 0:
+                raise AssertionError(f"negative refcount on page {p}")
+            if free and (rc != 0 or p in cached):
+                raise AssertionError(f"page {p} free while referenced/cached")
+            if not free and rc == 0 and p not in cached:
+                raise AssertionError(f"leaked page {p}: unreferenced, "
+                                     "uncached, not free")
 
     # -- stats ------------------------------------------------------------
 
     def utilization(self) -> float:
+        """Fraction of usable pages out of the free list (live + cached)."""
         usable = self.num_pages - 1
         return 1.0 - len(self._free) / max(usable, 1)
 

@@ -1,24 +1,30 @@
-"""FCFS continuous-batching scheduler (Orca, OSDI '22).
+"""FCFS continuous-batching scheduler (Orca, OSDI '22 + Sarathi, OSDI '24).
 
 The scheduler owns the WAITING queue, the slot occupancy map and the
 per-step token budget; the engine owns the device programs.  Every engine
 step asks :meth:`FCFSScheduler.schedule_step` which requests to admit
-into freed slots, then runs ONE decode step over all occupied slots —
-iteration-level scheduling instead of run-to-completion batches.
+into freed slots, then runs at most ``chunk budget`` tokens of prefill
+plus ONE decode step over all started slots — iteration-level scheduling
+instead of run-to-completion batches.
 
-Budget semantics (Orca's "token budget"): one engine step costs
-``n_active`` decode tokens (one per occupied slot) plus the FULL prompt
-length of every request admitted this step (its prefill runs before the
-step's decode).  Admission stops when the budget is spent, so a burst of
-long prompts cannot starve in-flight decodes of step latency; a lone
-request is force-admitted even over budget (no deadlock when the budget
-is smaller than a prompt).
+Budget semantics (Sarathi-Serve's chunked prefill): admission costs
+nothing up front — an admitted request's prompt is prefilled in CHUNKS
+across subsequent steps, co-scheduled with decode.  Each step the engine
+spends :meth:`prefill_budget` prompt tokens, i.e. ``token_budget`` minus
+one token per active decode, so a burst of long prompts can no longer
+stall every in-flight decode behind a monolithic prefill (the pre-r09
+failure mode that needed whole prompts force-admitted over budget).
+Admission is gated only by free slots and pages.
 
 Page accounting is conservative: a request is admitted only when the pool
 can hold its WHOLE worst-case sequence (prompt + max_new_tokens), so an
 admitted request can never die of page exhaustion mid-flight (no
 preemption/swap tier — requests are small relative to the pool; add
-eviction here if that stops holding).
+eviction here if that stops holding).  Prefix-cached pages
+(kv_pool.KVPool ``prefix_cache=True``) are matched AT ADMISSION: shared
+full pages are retained instead of allocated, a partial-tail match is
+handed to the engine as a copy-on-write candidate, and only the uncached
+remainder allocates fresh pages.
 """
 
 from __future__ import annotations
@@ -62,11 +68,21 @@ class Request:
 
 @dataclass
 class Admission:
-    """One scheduling decision: request -> slot, with its pages."""
+    """One scheduling decision: request -> slot, with its pages.
+
+    ``pages`` are freshly leased (refcount 1, this request's alone);
+    ``cached`` are prefix-index pages shared read-only (already retained);
+    ``cow`` is an optional ``(source_page, n_tokens)`` partial-tail match
+    the engine must copy-on-write into ``pages[0]`` (the source is
+    retained until the engine releases it after the copy); ``matched`` is
+    the total prompt tokens whose K/V need no recompute."""
 
     slot: int
     request: Request
     pages: List[int]
+    cached: List[int] = field(default_factory=list)
+    cow: Optional[Tuple[int, int]] = None
+    matched: int = 0
 
 
 class FCFSScheduler:
@@ -77,8 +93,8 @@ class FCFSScheduler:
         self.n_slots = n_slots
         self.pool = pool
         # default budget: every slot decoding plus one flagship-sized
-        # prefill per step keeps step latency bounded without starving
-        # admission
+        # prefill chunk per step keeps step latency bounded without
+        # starving admission
         self.token_budget = token_budget or (n_slots + 512)
         self.waiting: Deque[Request] = deque()
         self._free_slots: List[int] = list(range(n_slots - 1, -1, -1))
@@ -108,33 +124,65 @@ class FCFSScheduler:
 
     # -- per-step decisions ----------------------------------------------
 
+    def prefill_budget(self, n_decoding: int, chunk_tokens: int) -> int:
+        """Sarathi chunk budget for one step: the token budget left after
+        paying one token per active decode, capped at the engine's chunk
+        program width and floored at 1 so prefill always progresses even
+        when decodes alone exceed the budget."""
+        return max(1, min(chunk_tokens, self.token_budget - n_decoding))
+
     def schedule_step(self) -> List[Admission]:
-        """Admit FCFS from the waiting queue into free slots until slots,
-        pages or the step's token budget run out.  Head-of-line blocking
-        is intentional (FCFS fairness): if the HEAD doesn't fit we stop,
-        we don't scan deeper for a smaller request."""
+        """Admit FCFS from the waiting queue into free slots until slots
+        or pages run out.  Head-of-line blocking is intentional (FCFS
+        fairness): if the HEAD's pages don't fit we stop, we don't scan
+        deeper for a smaller request.  Prefix-cache matching happens
+        here, while this step's page arithmetic is decided: matched full
+        pages are retained (shared) instead of allocated, and a
+        partial-tail match rides along as the COW candidate."""
         admissions: List[Admission] = []
-        budget = self.token_budget - self.n_active
         while self.waiting and self._free_slots:
             req = self.waiting[0]
-            if req.prompt_len > budget:
-                # force-admit a lone request so an over-budget prompt can't
-                # deadlock an idle engine
-                if self.n_active > 0 or admissions:
-                    break
-            pages = self.pool.alloc(self.pool.pages_for(req.total_len))
+            cached: List[int] = []
+            cow: Optional[Tuple[int, int]] = None
+            held: List[int] = []
+            if self.pool.prefix is not None:
+                # never match the whole prompt: the last token must be
+                # prefilled so its logits exist to sample the first output
+                cached, cow = self.pool.prefix.match(req.prompt[:-1])
+                held = list(cached) + ([cow[0]] if cow else [])
+                # pin matches BEFORE alloc — alloc may LRU-evict
+                # reclaimable cached pages to satisfy the fresh lease
+                self.pool.retain(held)
+            need = self.pool.pages_for(req.total_len) - len(cached)
+            pages = self.pool.alloc(need)
+            if pages is None and cow is not None:
+                # the pinned COW source inflates peak demand by one page
+                # beyond the admission arithmetic (pages_for(total_len));
+                # for a request sized to the remaining pool that ONE page
+                # can make alloc fail forever — drop the partial match
+                # (full-page matches only ever reduce demand) and retry
+                self.pool.release([cow[0]])
+                held, cow = list(cached), None
+                pages = self.pool.alloc(need)
             if pages is None:
+                if held:
+                    self.pool.release(held)
                 break
+            matched = len(cached) * self.pool.page_size + \
+                (cow[1] if cow else 0)
             self.waiting.popleft()
             slot = self._free_slots.pop()
-            admissions.append(Admission(slot=slot, request=req, pages=pages))
-            budget -= req.prompt_len
+            admissions.append(Admission(slot=slot, request=req, pages=pages,
+                                        cached=cached, cow=cow,
+                                        matched=matched))
         return admissions
 
     def release(self, slot: int, pages: List[int]) -> None:
-        """A request finished: its slot and pages return to the free pools
-        (next step's schedule_step can hand them straight out again)."""
+        """A request finished: its slot frees and every page reference it
+        held drops (shared prefix pages simply lose one reference; pages
+        reaching refcount 0 return to the free list unless the prefix
+        index keeps them reclaimable)."""
         if slot in self._free_slots:
             raise ValueError(f"double release of slot {slot}")
-        self.pool.free(pages)
+        self.pool.release(pages)
         self._free_slots.append(slot)

@@ -83,6 +83,32 @@ def _fresh_programs():
         fr._DEFAULT_SEED = old_default_seed
 
 
+@pytest.fixture(autouse=True)
+def _serving_page_leak_guard(monkeypatch):
+    """Wrap every ServingEngine step in a page-leak / refcount-consistency
+    audit (r09 satellite): after each engine step the pool's free list,
+    refcounts and prefix index must balance, and the refcount total must
+    equal the page references live slots hold — so a future scheduler
+    change cannot silently leak pages and still pass the serving tests.
+    Applied lazily: tests that never touched the serving engine pay only
+    a sys.modules lookup."""
+    import sys
+
+    eng_mod = sys.modules.get("paddle_tpu.serving.engine")
+    if eng_mod is None:
+        yield
+        return
+    orig_step = eng_mod.ServingEngine.step
+
+    def checked_step(self):
+        fins = orig_step(self)
+        self.check_invariants()
+        return fins
+
+    monkeypatch.setattr(eng_mod.ServingEngine, "step", checked_step)
+    yield
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)

@@ -104,6 +104,27 @@ def test_serving_bench_poisson_arrivals():
     assert res["static"]["p50_latency_s"] > 0
 
 
+def test_prefix_serving_bench_smoke():
+    """Fast CPU smoke of the shared-system-prompt serving bench (ISSUE
+    r09 satellite): both engine runs (prefix cache off and on) complete
+    the same load, the cached run reports a NONZERO hit rate, and the
+    no-cache run reports zero (the control is really a control)."""
+    res = bench._prefix_serving_bench(hidden=48, layers=2, heads=2,
+                                      vocab=128, n_requests=4, max_slots=2,
+                                      page_size=8, shared_len=16,
+                                      unique_len=8, new_tokens=6,
+                                      dtype="float32", chunk_tokens=16,
+                                      decode_block=2)
+    assert res["no_cache"]["tokens_per_sec"] > 0
+    assert res["cache"]["tokens_per_sec"] > 0
+    assert res["no_cache"]["prefix_hit_rate"] == 0.0
+    assert res["cache"]["prefix_hit_rate"] > 0.0
+    # the cache must SAVE prefill work on the identical load
+    assert res["cache"]["prefill_calls"] < res["no_cache"]["prefill_calls"]
+    assert np.isfinite(res["speedup"])
+    assert res["config"]["useful_tokens"] == 4 * 6
+
+
 @pytest.mark.slow
 def test_serving_bench_tpu_scale():
     """The flagship-sized serving point bench.py records on TPU (marked
@@ -116,3 +137,19 @@ def test_serving_bench_tpu_scale():
                                new_tokens_max=256, dtype="bfloat16",
                                decode_block=16)
     assert res["speedup"] >= 1.3, res
+
+
+@pytest.mark.slow
+def test_prefix_serving_bench_tpu_scale():
+    """The flagship-sized shared-system-prompt point bench.py records on
+    TPU (marked slow).  The r09 acceptance bar lives here: a nonzero
+    prefix hit rate and goodput >= the no-cache engine path on the
+    identical load."""
+    res = bench._prefix_serving_bench(hidden=1536, layers=24, heads=12,
+                                      vocab=50304, n_requests=64,
+                                      max_slots=8, page_size=64,
+                                      shared_len=64, unique_len=64,
+                                      new_tokens=128, dtype="bfloat16",
+                                      chunk_tokens=128, decode_block=8)
+    assert res["cache"]["prefix_hit_rate"] > 0.0, res
+    assert res["speedup"] >= 1.0, res

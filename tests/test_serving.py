@@ -1,15 +1,21 @@
-"""Continuous-batching serving engine + paged KV cache (ISSUE r08).
+"""Continuous-batching serving engine + paged KV cache (ISSUE r08 + r09).
 
 Acceptance contracts, all CPU-runnable:
-  * the Pallas paged-attention kernel (interpret mode — the exact TPU code
-    path) matches the jnp reference for bf16-style float and int8 pages;
+  * the Pallas paged-attention decode kernel AND the paged-prefill chunk
+    kernel (interpret mode — the exact TPU code path) match their jnp
+    references for bf16-style float and int8 pages;
   * paged decode produces EXACTLY the dense-KV-cache decoder's greedy
     tokens (fp and int8, jnp path and interpret-kernel path, single device
-    and tp2, decode_block 1 and >1) on mixed-length prompts;
-  * the pool allocator and FCFS scheduler enforce their invariants (null
-    page, double-free, FCFS order, token budget, page-limited admission);
+    and tp2, decode_block 1 and >1, chunked and unchunked prefill, prefix
+    cache hits and misses, COW tail pages) on mixed-length prompts;
+  * the pool allocator, prefix index and FCFS scheduler enforce their
+    invariants (null page, O(1) double-free, refcounted sharing, LRU
+    eviction of reclaimable pages, FCFS order, chunk budget, page-limited
+    admission);
   * EOS frees the slot and its pages mid-flight and the engine admits the
-    next waiting request into them.
+    next waiting request into them; after a full drain the pool returns
+    to the cached-prefix-only baseline (asserted in run() itself and by
+    the conftest leak fixture after every step).
 """
 
 import numpy as np
@@ -20,9 +26,11 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.kernels import paged_attention as pa
+from paddle_tpu.kernels import paged_prefill as pp
 from paddle_tpu.models.generation import build_generate_fn
 from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
-from paddle_tpu.serving import FCFSScheduler, KVPool, Request, ServingEngine
+from paddle_tpu.serving import (FCFSScheduler, KVPool, PrefixIndex, Request,
+                                ServingEngine)
 
 CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
            max_seq_len=96, dropout=0.0)
@@ -136,7 +144,10 @@ def test_kv_pool_alloc_free_invariants():
     assert pool.buffers["k"].shape == (2, 8, 2, 4, 16)
 
 
-def test_scheduler_fcfs_budget_and_pages():
+def test_scheduler_fcfs_pages_gate_admission():
+    """Admission is slot- and page-gated FCFS; the token budget no longer
+    blocks admission (prefill is chunked, r09) — a blocked HEAD stops the
+    scan (no out-of-order admission of a smaller request)."""
     pool = KVPool(1, 1, 8, num_pages=9, page_size=4)
     sched = FCFSScheduler(n_slots=4, pool=pool, token_budget=10)
     rng = np.random.RandomState(0)
@@ -145,24 +156,24 @@ def test_scheduler_fcfs_budget_and_pages():
     for r in reqs:
         sched.add(r)
     adm = sched.schedule_step()
-    # budget 10: first prompt (6) fits, second (6) would exceed -> FCFS stop
-    assert [a.request.rid for a in adm] == [reqs[0].rid]
-    adm2 = sched.schedule_step()
-    assert [a.request.rid for a in adm2] == [reqs[1].rid]
-    # third blocked on PAGES now: 2 x ceil(10/4)=3 pages taken, 2 free < 3
+    # 8 usable pages, 3 per request: first two admit, third blocks on pages
+    assert [a.request.rid for a in adm] == [reqs[0].rid, reqs[1].rid]
     assert sched.schedule_step() == []
     sched.release(adm[0].slot, adm[0].pages)
     adm3 = sched.schedule_step()
     assert [a.request.rid for a in adm3] == [reqs[2].rid]
 
 
-def test_scheduler_force_admits_over_budget_when_idle():
+def test_scheduler_chunk_budget():
+    """Sarathi budget arithmetic: prefill allowance = token_budget minus
+    one token per active decode, capped at the chunk program width,
+    floored at 1 so a saturated decode batch can't starve prefill."""
     pool = KVPool(1, 1, 8, num_pages=20, page_size=4)
-    sched = FCFSScheduler(n_slots=2, pool=pool, token_budget=4)
-    big = Request(prompt=np.arange(30), max_new_tokens=2)
-    sched.add(big)
-    adm = sched.schedule_step()  # idle engine: over-budget prompt admitted
-    assert [a.request.rid for a in adm] == [big.rid]
+    sched = FCFSScheduler(n_slots=8, pool=pool, token_budget=16)
+    assert sched.prefill_budget(0, chunk_tokens=64) == 16
+    assert sched.prefill_budget(4, chunk_tokens=64) == 12
+    assert sched.prefill_budget(4, chunk_tokens=8) == 8   # chunk cap
+    assert sched.prefill_budget(99, chunk_tokens=8) == 1  # progress floor
 
 
 def test_scheduler_rejects_oversized_request():
@@ -203,13 +214,15 @@ def test_engine_greedy_matches_dense_decode(mode):
 @pytest.mark.parametrize("mode", ["jnp", "kernel"])
 def test_engine_int8_matches_dense_int8_decode(mode):
     """int8 paged decode (int8 pages + fp32 page scales, W8A8 projections)
-    == the dense int8-KV decoder, exactly, on the test configs."""
+    == the dense int8-KV decoder, exactly, on the test configs — with the
+    prompts CHUNK-prefilled (chunk_tokens=8) through the int8 paged
+    prefill path."""
     model = _model()
     rng = np.random.RandomState(5)
     prompts = _prompts(rng, (6, 13, 9))
     refs = _dense_greedy(model, prompts, 10, int8=True)
     eng = ServingEngine(model, max_slots=2, page_size=8, int8=True,
-                        use_paged_kernel=mode == "kernel")
+                        chunk_tokens=8, use_paged_kernel=mode == "kernel")
     assert eng.pool.buffers["k"].dtype == jnp.int8
     assert eng.pool.buffers["ks"].dtype == jnp.float32
     rids = [eng.add_request(p, 10) for p in prompts]
@@ -233,7 +246,9 @@ def test_engine_tp2_matches_single_device():
     tp = GPTForPretraining(GPTConfig(**CFG, use_parallel=True))
     tp.eval()
     for int8 in (False, True):
+        # fp leg also exercises tp2 x chunked prefill (chunk < prompt)
         eng = ServingEngine(tp, max_slots=2, page_size=8, int8=int8,
+                            chunk_tokens=128 if int8 else 4,
                             use_paged_kernel=False)
         rids = [eng.add_request(p, 8) for p in prompts]
         out = eng.run()
@@ -345,3 +360,325 @@ def test_engine_pool_exhaustion_queues_instead_of_failing():
     out = eng.run()
     for i, rid in enumerate(rids):
         np.testing.assert_array_equal(out[rid].tokens, refs[i])
+
+
+# ---------------------------------------------------------------------------
+# the paged-prefill chunk kernel (r09)
+# ---------------------------------------------------------------------------
+
+
+def test_paged_prefill_kernel_matches_ref_float():
+    rng = np.random.RandomState(40)
+    C, H, D, PS, MAXP, P = 7, 2, 16, 8, 4, 10
+    q = jnp.asarray(rng.randn(C, H, D).astype("float32"))
+    kp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    vp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    bt = jnp.asarray(rng.randint(1, P, (MAXP,)).astype("int32"))
+    for start in (0, 5, 13):
+        out = pp.paged_prefill(q, kp, vp, bt, start, interpret=True)
+        ref = pp.paged_prefill_ref(q, kp, vp, bt, start)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_paged_prefill_kernel_matches_ref_int8():
+    from paddle_tpu.ops.quant_ops import quantize_per_token
+
+    rng = np.random.RandomState(41)
+    C, H, D, PS, MAXP, P = 5, 3, 16, 8, 3, 8
+    q = jnp.asarray(rng.randn(C, H, D).astype("float32"))
+    kp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    vp = jnp.asarray(rng.randn(P, H, PS, D).astype("float32"))
+    kq, ks = quantize_per_token(kp)
+    vq, vs = quantize_per_token(vp)
+    bt = jnp.asarray(rng.randint(1, P, (MAXP,)).astype("int32"))
+    out = pp.paged_prefill(q, kq, vq, bt, 6, k_scales=ks, v_scales=vs,
+                           interpret=True)
+    ref = pp.paged_prefill_ref(q, kq, vq, bt, 6, k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # int8 pages approximate the float pages (quantization error band)
+    full = pp.paged_prefill_ref(q, kp, vp, bt, 6)
+    assert np.abs(np.asarray(ref) - np.asarray(full)).max() < 0.2
+
+
+def test_paged_prefill_ref_causal_mask():
+    """Chunk row i sees exactly positions <= start + i: rewriting any
+    later position (e.g. stale COW-page tail garbage, unwritten pool
+    zeros) cannot change that row's output."""
+    rng = np.random.RandomState(42)
+    P, H, PS, D, C, start = 5, 2, 8, 16, 4, 9
+    q = jnp.asarray(rng.randn(C, H, D).astype("float32"))
+    kp = rng.randn(P, H, PS, D).astype("float32")
+    vp = rng.randn(P, H, PS, D).astype("float32")
+    bt = jnp.asarray(np.array([1, 2, 3], "int32"))
+    a = pp.paged_prefill_ref(q, jnp.asarray(kp), jnp.asarray(vp), bt, start)
+    kp2, vp2 = kp.copy(), vp.copy()
+    # positions 11.. live at page idx 1 offset 3.. and page idx 2: row i
+    # sees up to start + i = 9 + i, so row 0 (sees <= 9) and row 1
+    # (sees <= 10) must be untouched by garbage at 11..
+    kp2[2, :, 3:] = 99.0
+    vp2[2, :, 3:] = -99.0
+    kp2[3], vp2[3] = 7.0, 7.0
+    b = pp.paged_prefill_ref(q, jnp.asarray(kp2), jnp.asarray(vp2), bt,
+                             start)
+    np.testing.assert_array_equal(np.asarray(a)[:2], np.asarray(b)[:2])
+    assert np.abs(np.asarray(a)[2:] - np.asarray(b)[2:]).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# refcounts, prefix index, O(1) allocator (r09)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_pool_refcount_sharing_and_reclaim():
+    """Shared pages die only at refcount 0; cached pages then park as
+    RECLAIMABLE (matchable, out of the free list) until allocation
+    pressure LRU-evicts them — never eagerly freed."""
+    pool = KVPool(1, 1, 8, num_pages=6, page_size=4, prefix_cache=True)
+    pages = pool.alloc(2)                     # rc 1 each
+    pool.prefix.insert(np.arange(8, dtype=np.int32), pages)
+    pool.retain(pages)                        # a second request shares them
+    pool.free(pages)                          # first owner done (rc 1)
+    assert pool.num_free == 3 and pool.pages_in_use == 2
+    pool.free(pages)                          # rc 0: cached -> reclaimable
+    assert pool.num_free == 3
+    assert pool.num_reclaimable == 2 and pool.pages_in_use == 0
+    with pytest.raises(ValueError):
+        pool.free(pages)                      # over-free fails loudly
+    with pytest.raises(ValueError):
+        pool.free([pool._free[-1]])           # free page double-free
+    got = pool.alloc(5)                       # needs the cached pages back
+    assert got is not None and len(got) == 5
+    assert pool.num_cached == 0 and len(pool.prefix) == 0
+    pool.check()
+    pool.free(got)
+    assert pool.num_free == 5
+
+
+def test_kv_pool_alloc_free_stress():
+    """Satellite: thousands of random alloc/retain/free cycles against the
+    set-mirrored free list keep every invariant (null page reserved, no
+    aliasing, refcounts balanced) — checked via pool.check()."""
+    rng = np.random.RandomState(0)
+    pool = KVPool(1, 1, 8, num_pages=64, page_size=4, prefix_cache=True)
+    live = []
+    for i in range(4000):
+        r = rng.rand()
+        if live and (r < 0.45 or pool.num_free < 4):
+            pool.free(live.pop(rng.randint(len(live))))
+        elif live and r < 0.55:
+            lease = live[rng.randint(len(live))]
+            pool.retain(lease)                # share...
+            pool.free(lease)                  # ...and drop again
+        else:
+            got = pool.alloc(int(rng.randint(1, 5)))
+            if got is not None:
+                live.append(got)
+        if i % 500 == 0:
+            pool.check()
+    for pages in live:
+        pool.free(pages)
+    pool.check()
+    assert pool.pages_in_use == 0 and pool.num_free == 63
+
+
+def test_prefix_index_match_insert_lru():
+    idx = PrefixIndex(4)
+    t = np.arange(16, dtype=np.int32)
+    assert idx.match(t) == ([], None)
+    assert idx.insert(t, [5, 6, 7, 8]) == [5, 6, 7, 8]
+    pages, partial = idx.match(t)
+    assert pages == [5, 6, 7, 8] and partial is None
+    # page-aligned prefix + partial-tail (COW) match
+    q = np.concatenate([t[:6], [99, 99]]).astype(np.int32)
+    pages, partial = idx.match(q)
+    assert pages == [5] and partial == (6, 2)
+    # an already-cached chunk keeps its page; the duplicate isn't adopted
+    assert idx.insert(t[:8], [50, 51]) == []
+    assert len(idx) == 4
+
+    # LRU eviction: refcount-0 LEAVES first, parents only once childless
+    idx2 = PrefixIndex(4)
+    idx2.insert(np.arange(8, dtype=np.int32), [1, 2])
+    # chunk 0 is already node 1 (the page slot is ignored); chunk 1 is new
+    idx2.insert(np.array([0, 1, 2, 3, 9, 9, 9, 9], np.int32), [1, 3])
+    idx2.match(np.arange(8, dtype=np.int32))      # branch [1, 2] is recent
+    rc = [0] * 10
+    assert idx2.evict(1, rc) == [3]               # LRU leaf goes first
+    assert idx2.evict(5, rc) == [2, 1]            # leaf, then freed parent
+    assert len(idx2) == 0
+    # a pinned leaf (refcount > 0) blocks itself AND its parent chain
+    idx3 = PrefixIndex(4)
+    idx3.insert(np.arange(8, dtype=np.int32), [1, 2])
+    assert idx3.evict(2, [0, 0, 1] + [0] * 7) == []
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill + prefix caching through the engine (r09)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["jnp", "kernel"])
+def test_engine_chunked_matches_dense_decode(mode):
+    """chunk_tokens=4 < page_size=8 (the satellite edge case): prompts
+    prefill in sub-page chunks across multiple program calls, greedy
+    tokens still EXACTLY match the dense decoder."""
+    model = _model()
+    rng = np.random.RandomState(13)
+    prompts = _prompts(rng, (5, 11, 9))
+    refs = _dense_greedy(model, prompts, 8)
+    eng = ServingEngine(model, max_slots=2, page_size=8, chunk_tokens=4,
+                        use_paged_kernel=mode == "kernel")
+    rids = [eng.add_request(p, 8) for p in prompts]
+    out = eng.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(out[rid].tokens, refs[i])
+    assert eng.stats["prefill_calls"] > len(prompts)  # chunking happened
+    assert eng.pool.pages_in_use == 0
+
+
+def test_engine_prefix_cache_hits_and_exact():
+    """Shared-system-prompt load: every request starts with the same
+    16-token prefix (2 full pages).  Greedy tokens match the dense
+    decoder EXACTLY while later admissions serve the shared pages from
+    cache, and the drained engine parks only reclaimable cached pages."""
+    model = _model()
+    rng = np.random.RandomState(21)
+    shared = rng.randint(0, 512, (16,)).astype("int32")
+    prompts = [np.concatenate([shared,
+                               rng.randint(0, 512, (n,)).astype("int32")])
+               for n in (5, 3, 7, 4)]
+    refs = _dense_greedy(model, prompts, 6)
+    eng = ServingEngine(model, max_slots=2, page_size=8, chunk_tokens=16)
+    rids = [eng.add_request(p, 6) for p in prompts]
+    out = eng.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(out[rid].tokens, refs[i])
+    # the first slot-pair admits cold; the second wave hits both pages
+    assert eng.stats["prefix_hit_tokens"] >= 2 * 16
+    assert 0.0 < eng.prefix_hit_rate() < 1.0
+    assert eng.pool.pages_in_use == 0
+    assert eng.pool.num_cached > 0
+    first = eng.stats["prefix_hit_tokens"]
+    # re-serving over the drained engine hits the cache immediately
+    rids2 = [eng.add_request(p, 6) for p in prompts[:2]]
+    out2 = eng.run()
+    for i, rid in enumerate(rids2):
+        np.testing.assert_array_equal(out2[rid].tokens, refs[i])
+    assert eng.stats["prefix_hit_tokens"] >= first + 2 * 16
+
+
+def test_engine_cow_tail_page():
+    """Copy-on-write partial-tail reuse: B shares A's first page plus
+    HALF of its second page — the engine clones the cached page and
+    prefills only the divergent suffix; an identical re-request (C) gets
+    everything but its final token from cache (the cap that keeps the
+    first output token computable).  Tokens stay exact throughout."""
+    model = _model(seed=4)
+    rng = np.random.RandomState(4)
+    A = rng.randint(0, 512, (16,)).astype("int32")
+    B = np.concatenate([A[:12], rng.randint(0, 512, (6,)).astype("int32")])
+    refA = _dense_greedy(model, [A], 6)[0]
+    refB = _dense_greedy(model, [B], 6)[0]
+    eng = ServingEngine(model, max_slots=1, page_size=8, chunk_tokens=16)
+    ra = eng.add_request(A, 6)
+    np.testing.assert_array_equal(eng.run()[ra].tokens, refA)
+    assert eng.stats["prefix_hit_tokens"] == 0
+    rb = eng.add_request(B, 6)
+    np.testing.assert_array_equal(eng.run()[rb].tokens, refB)
+    # B matched page 0 whole (8) + 4 tokens of A's second page via COW
+    assert eng.stats["prefix_hit_tokens"] == 12
+    rc = eng.add_request(A.copy(), 6)
+    np.testing.assert_array_equal(eng.run()[rc].tokens, refA)
+    # C matched page 0 whole (8) + 7 of 8 tokens of page 1 (capped at
+    # prompt_len - 1, served via COW)
+    assert eng.stats["prefix_hit_tokens"] == 12 + 15
+    assert eng.pool.pages_in_use == 0
+
+
+def test_engine_mid_prefill_admission_and_budget():
+    """Sarathi co-scheduling: a 16-token prompt at token_budget=4 spreads
+    its prefill over >= 4 steps WITHOUT blocking admission — the second
+    request occupies the other slot from step one — and both still finish
+    with exact tokens."""
+    model = _model()
+    rng = np.random.RandomState(31)
+    long_p = rng.randint(0, 512, (16,)).astype("int32")
+    short_p = rng.randint(0, 512, (4,)).astype("int32")
+    refs = _dense_greedy(model, [long_p, short_p], 4)
+    eng = ServingEngine(model, max_slots=2, page_size=8, chunk_tokens=4,
+                        token_budget=4, prefix_cache=False)
+    r1 = eng.add_request(long_p, 4)
+    r2 = eng.add_request(short_p, 4)
+    fins, steps = {}, 0
+    while eng.has_work:
+        for f in eng.step():
+            fins[f.rid] = f
+        steps += 1
+        if steps == 1:
+            assert eng.scheduler.n_active == 2  # head mid-prefill, both in
+    np.testing.assert_array_equal(fins[r1].tokens, refs[0])
+    np.testing.assert_array_equal(fins[r2].tokens, refs[1])
+    assert steps >= 5          # 16 prompt tokens at <= 4 per step + decode
+
+
+def test_engine_rejects_prompt_larger_than_pool():
+    """A prompt the page pool can never hold is rejected CLEANLY at
+    enqueue — not admitted to deadlock the loop — and pool-sized requests
+    after it still run."""
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=4)
+    with pytest.raises(ValueError):
+        eng.add_request(np.arange(30, dtype=np.int32) % 512, 8)  # 38 > 24
+    rng = np.random.RandomState(7)
+    p = rng.randint(0, 512, (6,)).astype("int32")
+    ref = _dense_greedy(model, [p], 4)[0]
+    rid = eng.add_request(p, 4)
+    np.testing.assert_array_equal(eng.run()[rid].tokens, ref)
+
+
+def test_engine_stats_and_teardown_leak_assert():
+    """engine.stats carries the r09 observability fields, and run()'s
+    teardown assert actually fires when a page reference leaks."""
+    model = _model()
+    rng = np.random.RandomState(17)
+    eng = ServingEngine(model, max_slots=2, page_size=8)
+    rid = eng.add_request(rng.randint(0, 512, (9,)).astype("int32"), 4)
+    out = eng.run()
+    assert len(out[rid].tokens) == 4
+    s = eng.stats
+    assert s["pages_in_use"] == 0 and s["queue_depth"] == 0
+    assert s["prompt_tokens"] == 9
+    assert s["step_wall_s"] > 0 and s["last_step_s"] > 0
+    eng.check_invariants()
+    stray = eng.pool.alloc(1)  # simulate a leaked page reference
+    with pytest.raises(AssertionError):
+        eng.run()
+    eng.pool.free(stray)
+    eng.run()                  # clean again
+
+
+def test_engine_cow_pin_cannot_deadlock_admission():
+    """Regression (r09 review): a request sized to the WHOLE remaining
+    pool whose prompt has a partial-tail (COW) match would pin the COW
+    source page and push peak demand one page over the admission
+    arithmetic — alloc failed identically every step, spinning run()
+    forever.  The scheduler must drop the COW match (never the full-page
+    matches) and admit."""
+    model = _model(seed=4)
+    rng = np.random.RandomState(4)
+    A = rng.randint(0, 512, (16,)).astype("int32")
+    refA = _dense_greedy(model, [A], 8)[0]
+    # 3 usable pages of 8 = 24 tokens; A caches its 2 full prompt pages
+    eng = ServingEngine(model, max_slots=1, page_size=8, num_pages=4,
+                        chunk_tokens=16)
+    ra = eng.add_request(A, 8)
+    np.testing.assert_array_equal(eng.run()[ra].tokens, refA)
+    # identical re-request needs the whole pool (16 + 8 = 24 tokens) and
+    # matches page 0 fully + 7 tokens of page 1 (the COW candidate)
+    rb = eng.add_request(A.copy(), 8)
+    np.testing.assert_array_equal(eng.run()[rb].tokens, refA)
+    # the full-page match survived even though the COW pin was dropped
+    assert eng.stats["prefix_hit_tokens"] == 8
+    assert eng.pool.pages_in_use == 0
