@@ -174,6 +174,13 @@ def main():
             max_slots=8, page_size=64, shared_len=64, unique_len=64,
             new_tokens=128, dtype="bfloat16", chunk_tokens=128,
             decode_block=8)
+        # overload: arrivals at 3x capacity with backpressure + deadlines
+        # vs an unbounded queue (ISSUE r10 acceptance: bounded goodput
+        # under overload >= 0.9x the at-capacity goodput)
+        serving_overload = _overload_serving_bench(
+            hidden=1536, layers=24, heads=12, vocab=50304, n_requests=48,
+            max_slots=8, page_size=64, prompt_len=96, new_tokens=96,
+            dtype="bfloat16", overload_factor=3.0, decode_block=8)
         resnet = _resnet50_bench()
         bert = _bert_bench()
         head = flagship
@@ -206,6 +213,10 @@ def main():
             hidden=64, layers=2, heads=2, vocab=256, n_requests=6,
             max_slots=2, page_size=8, shared_len=16, unique_len=8,
             new_tokens=8, dtype="float32", chunk_tokens=16, decode_block=2)
+        serving_overload = _overload_serving_bench(
+            hidden=64, layers=2, heads=2, vocab=256, n_requests=6,
+            max_slots=2, page_size=8, prompt_len=8, new_tokens=12,
+            dtype="float32", overload_factor=3.0, decode_block=2)
         small = None
 
     out = {
@@ -227,6 +238,7 @@ def main():
     out["extra"]["decode"] = decode
     out["extra"]["serving"] = serving
     out["extra"]["serving_prefix"] = serving_prefix
+    out["extra"]["serving_overload"] = serving_overload
     if small is not None:
         out["extra"]["small_config"] = small
         out["extra"]["long_seq_config"] = long_seq
@@ -563,6 +575,134 @@ def _prefix_serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
                    "chunk_tokens": chunk_tokens,
                    "decode_block": decode_block,
                    "useful_tokens": useful},
+    }
+
+
+def _overload_serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
+                            n_requests=48, max_slots=8, page_size=64,
+                            prompt_len=96, new_tokens=96, dtype="bfloat16",
+                            overload_factor=3.0, max_queue=None,
+                            deadline_factor=8.0, decode_block=8, seed=0):
+    """Overload behavior: Poisson arrivals FASTER than capacity (r10).
+
+    Phase 1 calibrates: the request set bursts through an unbounded
+    engine at t=0, giving the at-capacity goodput and completion rate.
+    Phase 2 replays the SAME requests with Poisson arrivals at
+    ``overload_factor`` x that completion rate through two engines:
+
+      * **bounded**: ``max_queue`` (default ``2 * max_slots``) rejects
+        overflow at enqueue and every request carries a deadline of
+        ``deadline_factor`` x the at-capacity mean latency — the r10
+        backpressure posture: shed load early, keep serving the rest;
+      * **unbounded**: no queue bound, no deadlines — every request
+        eventually completes, but the queue (and every latency) grows
+        without bound for the whole overload window.
+
+    Goodput counts COMPLETED useful tokens over the makespan (rejected /
+    expired requests contribute zero), plus p99 latency of completed
+    requests and the reject/expire rates.  The acceptance bar
+    (tests/test_bench_extras.py, slow): bounded goodput under overload
+    >= 0.9x the at-capacity goodput — backpressure holds throughput
+    while the unbounded queue p99 degrades with queue depth.
+    """
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_seq_len=prompt_len + new_tokens,
+                    dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    if dtype == "bfloat16":
+        for p in model.parameters():
+            p._array = p._array.astype(jnp.bfloat16)
+
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(0, vocab, (n_requests, prompt_len)).astype("int32")
+    max_queue = max_queue if max_queue is not None else 2 * max_slots
+
+    def build(queue_bound=None):
+        eng = ServingEngine(model, max_slots=max_slots, page_size=page_size,
+                            greedy=True, decode_block=decode_block,
+                            prefix_cache=False, max_queue=queue_bound)
+        eng.add_request(prompts[0], 2)    # compile prefill + decode
+        eng.run()
+        for k in ("prefill_calls", "decode_calls", "tokens_generated",
+                  "rejected", "expired", "cancelled", "preemptions"):
+            eng.stats[k] = 0
+        return eng
+
+    def drive(eng, arrivals, deadline_s):
+        order = np.argsort(arrivals, kind="stable")
+        pending = [(float(arrivals[j]), j) for j in order]
+        rid2idx, fins = {}, {}
+        pre0 = eng.stats["preemptions"]   # engines may be reused (drained)
+        t0 = time.perf_counter()
+        makespan = 1e-9
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, j = pending.pop(0)
+                rid = eng.add_request(prompts[j], new_tokens,
+                                      deadline_s=deadline_s)
+                rid2idx[rid] = j
+            if not eng.has_work:
+                if pending:
+                    time.sleep(min(pending[0][0] - now, 0.01))
+                continue
+            for fin in eng.step():
+                done = time.perf_counter() - t0
+                fins[rid2idx[fin.rid]] = (fin, done - arrivals[rid2idx[fin.rid]])
+                makespan = done
+        good = [lat for fin, lat in fins.values() if fin.ok]
+        goodput_tokens = sum(int(fin.tokens.size)
+                             for fin, _ in fins.values() if fin.ok)
+        n_rej = sum(1 for fin, _ in fins.values()
+                    if fin.finish_reason == "rejected")
+        n_exp = sum(1 for fin, _ in fins.values()
+                    if fin.finish_reason == "expired")
+        return {
+            "goodput_tokens_per_sec": round(goodput_tokens / makespan, 1),
+            "makespan_s": round(makespan, 3),
+            "completed": len(good),
+            "p99_latency_s": (round(float(np.percentile(good, 99)), 3)
+                              if good else None),
+            "reject_rate": round(n_rej / n_requests, 3),
+            "expire_rate": round(n_exp / n_requests, 3),
+            "preemptions": eng.stats["preemptions"] - pre0,
+        }
+
+    # -- phase 1: at capacity (burst, unbounded, no deadlines) -----------
+    burst = np.zeros(n_requests)
+    eng_unbounded = build()   # drained engines are reusable: this one
+    #                           serves calibration AND the unbounded leg
+    at_cap = drive(eng_unbounded, burst, None)
+    mean_lat = max(at_cap["makespan_s"] / max(n_requests, 1), 1e-3)
+    deadline_s = deadline_factor * mean_lat
+    rate = overload_factor * n_requests / at_cap["makespan_s"]
+
+    # -- phase 2: overload arrivals ---------------------------------------
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    bounded = drive(build(queue_bound=max_queue), arrivals, deadline_s)
+    unbounded = drive(eng_unbounded, arrivals, None)
+    return {
+        "at_capacity": at_cap,
+        "overload_bounded": bounded,
+        "overload_unbounded": unbounded,
+        "goodput_ratio_bounded_vs_capacity": round(
+            bounded["goodput_tokens_per_sec"]
+            / max(at_cap["goodput_tokens_per_sec"], 1e-9), 3),
+        "config": {"hidden": hidden, "layers": layers, "heads": heads,
+                   "vocab": vocab, "n_requests": n_requests,
+                   "max_slots": max_slots, "page_size": page_size,
+                   "prompt_len": prompt_len, "new_tokens": new_tokens,
+                   "dtype": dtype, "overload_factor": overload_factor,
+                   "max_queue": max_queue,
+                   "deadline_s": round(deadline_s, 4),
+                   "decode_block": decode_block},
     }
 
 

@@ -9,12 +9,22 @@ continuous-batching behavior a static-batch decoder cannot show.  With
 system prompt): the engine computes its KV pages once and later requests
 reuse them from the prefix cache, visible in the final hit-rate line.
 
+Fault tolerance (r10): ``--deadline-ms`` expires requests that overstay,
+``--max-queue`` bounds the waiting queue (overflow rejects instead of
+growing without bound), and ``--inject-faults SEED`` runs the whole load
+under a seeded chaos plan (scripted alloc failures, mid-step exceptions,
+virtual step latency) — every request still reaches exactly one terminal
+state and the drained pool holds zero pages, printed in the final
+summary.
+
 CPU-runnable out of the box (tiny config); flags scale it up::
 
     python examples/serve_gpt.py                 # tiny, fp32, CPU-friendly
     python examples/serve_gpt.py --int8          # int8 KV pages + W8A8
     python examples/serve_gpt.py --slots 8 --page-size 32 --decode-block 8
     python examples/serve_gpt.py --shared-prefix 32 --chunk-tokens 16
+    python examples/serve_gpt.py --deadline-ms 500 --max-queue 4
+    python examples/serve_gpt.py --inject-faults 7   # deterministic chaos
 """
 
 import argparse
@@ -51,11 +61,20 @@ def main():
                     help="< 1.0 switches greedy off and nucleus-samples")
     ap.add_argument("--eos", type=int, default=None,
                     help="eos token id: finished slots free their pages")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline: requests overstaying this "
+                         "many ms (queued or resident) expire")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bound the waiting queue; overflow is rejected "
+                         "with an explicit terminal (backpressure)")
+    ap.add_argument("--inject-faults", type=int, default=None, metavar="SEED",
+                    help="run under a seeded FaultPlan: scripted alloc "
+                         "failures, step exceptions and virtual latency")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
-    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving import FaultPlan, ServingEngine
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
@@ -64,13 +83,16 @@ def main():
     model = GPTForPretraining(cfg)
     model.eval()
 
+    faults = (FaultPlan.random(args.inject_faults, n_steps=50)
+              if args.inject_faults is not None else None)
     eng = ServingEngine(model, max_slots=args.slots,
                         page_size=args.page_size,
                         decode_block=args.decode_block,
                         chunk_tokens=args.chunk_tokens,
                         prefix_cache=not args.no_prefix_cache,
                         greedy=args.top_p >= 1.0, top_p=args.top_p,
-                        eos_token_id=args.eos, int8=args.int8)
+                        eos_token_id=args.eos, int8=args.int8,
+                        max_queue=args.max_queue, faults=faults)
     print(f"engine: slots={args.slots} page_size={args.page_size} "
           f"pool={eng.pool.num_pages} pages "
           f"({eng.pool.hbm_bytes() / 1e6:.1f} MB) int8={args.int8}")
@@ -83,7 +105,10 @@ def main():
         new = int(rng.randint(4, args.max_seq // 2))
         prompt = np.concatenate(
             [system, rng.randint(0, args.vocab, (plen,))])
-        rid = eng.add_request(prompt, new)
+        rid = eng.add_request(
+            prompt, new,
+            deadline_s=(args.deadline_ms / 1e3
+                        if args.deadline_ms is not None else None))
         rids[rid] = (len(prompt), new)
         print(f"  queued rid={rid} prompt_len={len(prompt)} max_new={new}")
 
@@ -113,6 +138,18 @@ def main():
           f"prompt tokens served from cached pages "
           f"({eng.prefix_hit_rate():.0%} hit rate), "
           f"{eng.pool.num_cached} pages cached for future requests")
+    print(f"lifecycle: {s['preemptions']} preemption(s) "
+          f"({s['recompute_tokens']} tokens recomputed), "
+          f"{s['rejected']} rejected, {s['expired']} expired, "
+          f"{s['cancelled']} cancelled, {s['step_faults']} step fault(s) "
+          f"absorbed")
+    if faults is not None:
+        print(f"fault plan (seed {args.inject_faults}): "
+              f"{faults.injected['alloc_fail']} alloc failure(s), "
+              f"{faults.injected['raise']} injected exception(s), "
+              f"{faults.injected['latency_s'] * 1e3:.1f}ms virtual latency "
+              f"— pool drained leak-free: {eng.pool.pages_in_use == 0}")
+    eng.check_invariants()
 
 
 if __name__ == "__main__":

@@ -17,7 +17,14 @@ Composes four pieces:
     over TWO reusable jitted programs (chunked prefill-into-pages +
     single decode step over the slot batch), backed by the Pallas
     paged-attention decode and paged-prefill chunk kernels
-    (kernels/paged_attention.py, kernels/paged_prefill.py).
+    (kernels/paged_attention.py, kernels/paged_prefill.py);
+  * fault tolerance (r10): on-demand page growth with
+    preempt-and-recompute under pool pressure, per-request deadlines /
+    ``cancel`` / bounded-queue backpressure,
+    :func:`~paddle_tpu.serving.snapshot.snapshot_engine` /
+    :func:`~paddle_tpu.serving.snapshot.restore_engine` for exact
+    resume, and the deterministic
+    :class:`~paddle_tpu.serving.faults.FaultPlan` chaos harness.
 
 See README "Serving" for the architecture and knobs;
 ``examples/serve_gpt.py`` for the end-to-end loop.
@@ -26,7 +33,11 @@ See README "Serving" for the architecture and knobs;
 from .kv_pool import KVPool
 from .prefix_cache import PrefixIndex
 from .scheduler import Admission, FCFSScheduler, Request
-from .engine import FinishedRequest, ServingEngine
+from .engine import TERMINAL_REASONS, FinishedRequest, ServingEngine
+from .faults import FaultPlan, InjectedFault
+from .snapshot import restore_engine, snapshot_engine
 
 __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
-           "ServingEngine", "FinishedRequest"]
+           "ServingEngine", "FinishedRequest", "TERMINAL_REASONS",
+           "FaultPlan", "InjectedFault", "snapshot_engine",
+           "restore_engine"]

@@ -34,6 +34,38 @@ and cached pages park reclaimable (LRU-evicted under pressure) instead
 of being eagerly freed — a shared system prompt is computed once and
 reused by every later request.
 
+Fault tolerance (r10) — the engine degrades instead of failing:
+
+  * **On-demand page growth + preempt-and-recompute.**  Admission
+    reserves pages for the PROMPT only; decode allocates one page the
+    step a slot crosses a page boundary.  When growth (or admission)
+    meets an empty pool, the engine preempts the YOUNGEST occupied slot
+    — pages freed, generated tokens kept on the request, requeued at
+    the head of the waiting queue for recompute-restart through the
+    chunked-prefill path (vLLM's preempt-by-recompute; the prefix cache
+    makes the recompute cheap because the victim's full prompt pages
+    park reclaimable and are re-adopted at re-admission).  The OLDEST
+    request (admission seq preserved across preemptions) is never a
+    victim, so it always progresses — no livelock.  Greedy outputs are
+    token-for-token identical to an unpressured run.
+  * **Request lifecycle.**  ``deadline_s`` expires a request at
+    queue-pop and per-step; ``cancel(rid)`` works in any state (waiting,
+    mid-prefill, decoding — pages released the same call); ``max_queue``
+    bounds the waiting queue and converts overflow into an explicit
+    ``rejected`` terminal (backpressure) instead of unbounded growth.
+    Every request ends in EXACTLY one of
+    {``eos``, ``length``, ``rejected``, ``expired``, ``cancelled``},
+    delivered as a :class:`FinishedRequest` from ``step()``.
+  * **Snapshot / restore.**  ``snapshot()`` captures queue + slot
+    metadata + pool/prefix state + host mirrors;
+    ``ServingEngine.restore`` resumes a killed host loop with
+    token-for-token identical output (serving/snapshot.py).
+  * **Deterministic fault injection.**  A ``faults=FaultPlan`` scripts
+    alloc failures, phase-boundary step exceptions and virtual step
+    latency by step index (serving/faults.py); the engine absorbs them
+    (``stats["step_faults"]``) and the chaos suite asserts
+    terminal-state totality + leak-free drain under any seed.
+
 Every host-loop iteration the FCFS scheduler admits waiting requests
 into freed slots, the chunk budget advances partial prefills, exactly
 one decode call covers the started slots, and finished requests return —
@@ -47,7 +79,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -63,19 +95,38 @@ from ..models.generation import (
 )
 from ..kernels import paged_attention as pa
 from ..kernels import paged_prefill as pp
+from .faults import FaultPlan, InjectedFault
 from .kv_pool import KVPool
 from .scheduler import FCFSScheduler, Request
+
+#: Reasons a request leaves the engine.  "eos"/"length" are successful
+#: completions; the r10 lifecycle adds the degraded terminals.
+TERMINAL_REASONS = ("eos", "length", "rejected", "expired", "cancelled")
 
 
 @dataclasses.dataclass
 class FinishedRequest:
-    """One completed generation: the continuation (prompt excluded)."""
+    """One terminal request: the continuation produced (prompt excluded).
+
+    ``finish_reason`` is one of :data:`TERMINAL_REASONS`; ``reason`` is
+    the same value under the r10 lifecycle name.  For degraded terminals
+    (``rejected``/``expired``/``cancelled``) ``tokens`` holds whatever
+    was generated before the request left (possibly empty)."""
 
     rid: int
     prompt: np.ndarray
     tokens: np.ndarray            # generated continuation, EOS included
-    finish_reason: str            # "eos" | "length"
+    finish_reason: str
     n_steps: int                  # engine steps it was resident
+
+    @property
+    def reason(self) -> str:
+        return self.finish_reason
+
+    @property
+    def ok(self) -> bool:
+        """True when the request ran to completion (eos/length)."""
+        return self.finish_reason in ("eos", "length")
 
 
 def _next_pow2(n: int) -> int:
@@ -89,13 +140,18 @@ class _Slot:
     """Host-side state of one occupied engine slot."""
 
     def __init__(self, request: Request, pages: List[int], prefilled: int,
-                 seq: int):
+                 seq: int, base_len: int):
         self.request = request
         self.pages = pages            # table order: shared prefix + owned
-        self.tokens: List[int] = []
+        # generated tokens live ON THE REQUEST so they survive preemption;
+        # the slot aliases the same list
+        self.tokens: List[int] = request.generated
         self.born_step = 0
-        self.seq = seq                # admission order (FCFS chunk budget)
-        self.prefilled = prefilled    # prompt positions with K/V in pages
+        self.seq = seq                # admission order (FCFS, preserved
+        #                               across preemption — oldest is
+        #                               never a preemption victim)
+        self.base_len = base_len      # work-prompt length at admission
+        self.prefilled = prefilled    # work positions with K/V in pages
         self.started = False          # first token sampled; decoding
 
 
@@ -114,6 +170,13 @@ class ServingEngine:
     pages.  ``use_paged_kernel`` forces the Pallas kernels (or the jnp
     references) instead of auto-dispatch — tests use it to pin the
     interpret-mode kernel path on CPU.
+
+    r10 lifecycle knobs: ``max_queue`` bounds the waiting queue (overflow
+    becomes a ``rejected`` terminal); ``faults`` installs a
+    :class:`~paddle_tpu.serving.faults.FaultPlan`; ``clock`` overrides
+    the deadline clock (a zero-arg callable returning seconds — defaults
+    to the fault plan's virtual clock when one is set, else
+    ``time.monotonic``).
     """
 
     def __init__(self, model, *, max_slots: int = 8, page_size: int = 32,
@@ -126,7 +189,10 @@ class ServingEngine:
                  int8: Optional[bool] = None, seed: int = 0,
                  decode_block: int = 1,
                  use_paged_kernel: Optional[bool] = None,
-                 chunk_tokens: int = 128, prefix_cache: bool = True):
+                 chunk_tokens: int = 128, prefix_cache: bool = True,
+                 max_queue: Optional[int] = None,
+                 faults: Optional[FaultPlan] = None,
+                 clock: Optional[Callable[[], float]] = None):
         cfg = model.cfg
         self.cfg = cfg
         # decode_block > 1 fuses that many decode steps into ONE dispatched
@@ -148,11 +214,20 @@ class ServingEngine:
         self.max_pages = -(-self.max_seq_len // page_size)
         self.eos_token_id = eos_token_id
         self.chunk_tokens = max(1, min(int(chunk_tokens), self.max_seq_len))
+        self.max_queue = max_queue
+        self.faults = faults
+        if clock is not None:
+            self._clock = clock
+        elif faults is not None:
+            self._clock = faults.now
+        else:
+            self._clock = time.monotonic
         dtype = self.params["wte"].dtype
         n_pages = num_pages or (1 + max_slots * self.max_pages)
         self.pool = KVPool(cfg.num_layers, cfg.num_heads, self.head_dim,
                            n_pages, page_size, dtype=dtype, int8=self.int8,
                            prefix_cache=prefix_cache)
+        self.pool.faults = faults
         self.scheduler = FCFSScheduler(max_slots, self.pool,
                                        token_budget=token_budget)
         self._sample = _make_sampler(greedy, temperature, top_k, top_p)
@@ -165,6 +240,19 @@ class ServingEngine:
             self._use_kernel = bool(use_paged_kernel)
             self._use_prefill_kernel = bool(use_paged_kernel)
 
+        # ctor echo for snapshot/restore (serving/snapshot.py): enough to
+        # rebuild an equivalent engine around the captured state.  faults
+        # and clock are deliberately NOT part of a snapshot.
+        self._config = dict(
+            max_slots=max_slots, page_size=page_size,
+            max_seq_len=self.max_seq_len, num_pages=n_pages,
+            token_budget=self.scheduler.token_budget, greedy=greedy,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, int8=self.int8, seed=seed,
+            decode_block=decode_block, use_paged_kernel=use_paged_kernel,
+            chunk_tokens=chunk_tokens, prefix_cache=prefix_cache,
+            max_queue=max_queue)
+
         # host mirrors of the decode step's device operands
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._tok = np.zeros((max_slots,), np.int32)
@@ -173,12 +261,18 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(seed)
         self._step_idx = 0
         self._admit_seq = 0
+        # terminals produced OUTSIDE step() (reject at enqueue, cancel,
+        # …) park here and are delivered by the next step()
+        self._pending: List[FinishedRequest] = []
         self.stats = {"prefill_calls": 0, "decode_calls": 0,
                       "prefill_traces": 0, "decode_traces": 0,
                       "tokens_generated": 0,
                       "prefix_hit_tokens": 0, "prompt_tokens": 0,
                       "pages_in_use": 0, "queue_depth": 0,
-                      "step_wall_s": 0.0, "last_step_s": 0.0}
+                      "step_wall_s": 0.0, "last_step_s": 0.0,
+                      "preemptions": 0, "recompute_tokens": 0,
+                      "rejected": 0, "expired": 0, "cancelled": 0,
+                      "step_faults": 0}
         self._decode_fn = self._build_decode()
         self._prefill_fn = self._build_prefill()
         self._cow_fn = self._build_cow()
@@ -329,35 +423,100 @@ class ServingEngine:
     # -- public API -------------------------------------------------------
 
     def add_request(self, prompt, max_new_tokens: int,
-                    arrival: float = 0.0) -> int:
+                    arrival: float = 0.0,
+                    deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its rid.  The prompt + continuation
-        must fit ``max_seq_len`` (the slot's block-table width)."""
+        must fit ``max_seq_len`` (the slot's block-table width).
+        ``deadline_s`` expires the request that many engine-clock seconds
+        after enqueue, whatever state it is in."""
         return self._enqueue(
             Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
-                    max_new_tokens=max_new_tokens, arrival=arrival))
+                    max_new_tokens=max_new_tokens, arrival=arrival,
+                    deadline_s=deadline_s))
 
     def _enqueue(self, req: Request) -> int:
         """Single admission gate for both add_request and run(): every
         request must fit the model's position table / block-table width,
-        whichever path it arrives by."""
+        whichever path it arrives by.  A full waiting queue REJECTS the
+        request (backpressure): it still gets a rid and a terminal
+        ``rejected`` FinishedRequest from the next step()."""
         if req.total_len > self.max_seq_len:
             raise ValueError(
                 f"request needs {req.total_len} positions; engine "
                 f"max_seq_len is {self.max_seq_len}")
+        req.t_enqueue = self._now()
+        if (self.max_queue is not None
+                and self.scheduler.n_waiting >= self.max_queue):
+            self.stats["rejected"] += 1
+            self._pending.append(self._terminal(req, "rejected"))
+            return req.rid
         return self.scheduler.add(req)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request in ANY live state — waiting, mid-prefill or
+        decoding.  Pages are released immediately (same step); the
+        terminal ``cancelled`` FinishedRequest (with any tokens generated
+        so far) is delivered by the next step().  Returns False when the
+        rid is unknown or already terminal."""
+        req = self.scheduler.remove_waiting(rid)
+        if req is not None:
+            self.stats["cancelled"] += 1
+            self._pending.append(self._terminal(req, "cancelled"))
+            return True
+        for idx, st in enumerate(self._slots):
+            if st is not None and st.request.rid == rid:
+                self.stats["cancelled"] += 1
+                self._pending.append(self._finish(idx, "cancelled"))
+                return True
+        return False
 
     @property
     def has_work(self) -> bool:
-        return self.scheduler.has_work
+        return self.scheduler.has_work or bool(self._pending)
 
     def prefix_hit_rate(self) -> float:
         """Fraction of prompt tokens served from cached KV pages."""
         return self.stats["prefix_hit_tokens"] / max(
             self.stats["prompt_tokens"], 1)
 
+    def snapshot(self) -> dict:
+        """Capture the whole engine state (queue, slots, pool, prefix
+        index, host mirrors, RNG) as plain numpy/python — see
+        serving/snapshot.py.  ``ServingEngine.restore(model, snap)``
+        resumes token-for-token."""
+        from .snapshot import snapshot_engine
+
+        return snapshot_engine(self)
+
+    @classmethod
+    def restore(cls, model, snap: dict, **overrides) -> "ServingEngine":
+        """Rebuild an engine around ``model`` (same weights as the
+        snapshotted one) and resume from ``snap``."""
+        from .snapshot import restore_engine
+
+        return restore_engine(model, snap, **overrides)
+
+    # -- internals --------------------------------------------------------
+
+    def _now(self) -> float:
+        return self._clock()
+
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def _fault_point(self, phase: str) -> None:
+        if self.faults is not None:
+            self.faults.check_raise(phase)
+
+    def _terminal(self, req: Request, reason: str) -> FinishedRequest:
+        """Terminal record for a request that is NOT in a slot (waiting
+        or rejected at enqueue) — generated tokens from any earlier
+        residency ride along."""
+        return FinishedRequest(
+            rid=req.rid, prompt=req.prompt,
+            tokens=np.asarray(req.generated, np.int32),
+            finish_reason=reason, n_steps=0)
 
     def _finish(self, idx: int, reason: str) -> FinishedRequest:
         st = self._slots[idx]
@@ -370,6 +529,45 @@ class ServingEngine:
             rid=st.request.rid, prompt=st.request.prompt,
             tokens=np.asarray(st.tokens, np.int32), finish_reason=reason,
             n_steps=self._step_idx - st.born_step + 1)
+
+    def _preempt(self, idx: int) -> None:
+        """Evict slot ``idx`` to recompute later: pages freed (cached
+        prompt pages park reclaimable in the prefix index — the cheap
+        part of the recompute), generated tokens kept on the request,
+        request requeued at the HEAD of the waiting queue (FCFS: it
+        predates everything still waiting)."""
+        st = self._slots[idx]
+        self._slots[idx] = None
+        self._table[idx] = 0
+        self._tok[idx] = 0
+        self._len[idx] = 0
+        self.scheduler.release(idx, st.pages)
+        st.request.n_preempted += 1
+        self.scheduler.requeue(st.request)
+        self.stats["preemptions"] += 1
+
+    def _pick_victim(self) -> Optional[int]:
+        """The youngest occupied slot (largest admission seq) — unless it
+        is the ONLY one: the oldest request is never preempted, so the
+        system always makes forward progress (no livelock)."""
+        occ = [(self._slots[i].seq, i) for i in range(self.max_slots)
+               if self._slots[i] is not None]
+        if len(occ) <= 1:
+            return None
+        return max(occ)[1]
+
+    def _expire(self, finished: List[FinishedRequest]) -> None:
+        """Deadline enforcement, both sides: overdue WAITING requests are
+        dropped at queue-pop time (before this step's admissions), and
+        overdue SLOTS release their pages mid-flight."""
+        now = self._now()
+        for req in self.scheduler.pop_expired(now):
+            self.stats["expired"] += 1
+            finished.append(self._terminal(req, "expired"))
+        for idx, st in enumerate(self._slots):
+            if st is not None and st.request.expired(now):
+                self.stats["expired"] += 1
+                finished.append(self._finish(idx, "expired"))
 
     def _admit(self, adm) -> None:
         """Apply one scheduling decision: build the slot's block table
@@ -384,21 +582,29 @@ class ServingEngine:
             self.pool.buffers = self._cow_fn(
                 self.pool.buffers, jnp.int32(src), jnp.int32(adm.pages[0]))
             self.pool.release([src])
-        self._admit_seq += 1
-        st = _Slot(req, pages, prefilled=adm.matched, seq=self._admit_seq)
+        if req.seq is None:
+            # first admission fixes the request's age; preemption keeps it
+            self._admit_seq += 1
+            req.seq = self._admit_seq
+        st = _Slot(req, pages, prefilled=adm.matched, seq=req.seq,
+                   base_len=req.work_len)
         st.born_step = self._step_idx
         self._slots[idx] = st
         row = np.zeros((self.max_pages,), np.int32)
         row[:len(pages)] = pages
         self._table[idx] = row
         self.stats["prefix_hit_tokens"] += adm.matched
-        self.stats["prompt_tokens"] += req.prompt_len
+        self.stats["prompt_tokens"] += req.work_len
+        if req.n_preempted > 0:
+            # the uncached remainder of the work prompt is recomputation
+            self.stats["recompute_tokens"] += req.work_len - adm.matched
 
     def _prefill_chunks(self, finished: List[FinishedRequest]) -> None:
         """Spend the step's chunk budget FCFS over partially-prefilled
         slots: at most ``prefill_budget`` prompt tokens total, each call
-        one chunk of one slot's prompt.  A slot whose prompt completes
-        samples its first token and joins this step's decode batch."""
+        one chunk of one slot's work prompt (prompt + any
+        preemption-survived tokens).  A slot whose prompt completes
+        samples its next token and joins this step's decode batch."""
         n_decoding = sum(1 for s in self._slots
                          if s is not None and s.started)
         budget = self.scheduler.prefill_budget(n_decoding, self.chunk_tokens)
@@ -408,13 +614,14 @@ class ServingEngine:
             key=lambda i: self._slots[i].seq)
         for idx in partial:
             st = self._slots[idx]
+            work = st.request.work_prompt()
             while budget > 0 and not st.started:
-                n = min(st.request.prompt_len - st.prefilled, budget,
+                n = min(st.base_len - st.prefilled, budget,
                         self.chunk_tokens)
                 c_pad = min(_next_pow2(max(n, 8)),
                             max(self.chunk_tokens, n))
                 toks = np.zeros((c_pad,), np.int32)
-                toks[:n] = st.request.prompt[st.prefilled:st.prefilled + n]
+                toks[:n] = work[st.prefilled:st.prefilled + n]
                 self.pool.buffers, tok = self._prefill_fn(
                     self.params, self.pool.buffers, jnp.asarray(toks),
                     jnp.int32(st.prefilled), jnp.int32(n),
@@ -423,20 +630,19 @@ class ServingEngine:
                 self.stats["prefill_calls"] += 1
                 st.prefilled += n
                 budget -= n
-                if st.prefilled < st.request.prompt_len:
+                if st.prefilled < st.base_len:
                     continue
-                # prompt complete: first token sampled; its full pages
+                # prompt complete: next token sampled; its full pages
                 # become matchable for every later request
                 st.started = True
                 if self.pool.prefix is not None:
-                    nfull = st.request.prompt_len // self.page_size
-                    self.pool.prefix.insert(st.request.prompt,
-                                            st.pages[:nfull])
+                    nfull = st.base_len // self.page_size
+                    self.pool.prefix.insert(work, st.pages[:nfull])
                 tok = int(tok)
                 st.tokens.append(tok)
                 self.stats["tokens_generated"] += 1
                 self._tok[idx] = tok
-                self._len[idx] = st.request.prompt_len
+                self._len[idx] = st.base_len
                 if (self.eos_token_id is not None
                         and tok == self.eos_token_id):
                     finished.append(self._finish(idx, "eos"))
@@ -445,33 +651,102 @@ class ServingEngine:
             if budget <= 0:
                 break
 
-    def step(self) -> List[FinishedRequest]:
-        """One engine iteration: admit into freed slots, advance partial
-        prefills by the chunk budget, then one decode step over every
-        started slot.  Returns requests that finished this step (EOS or
-        length)."""
-        t0 = time.perf_counter()
-        finished: List[FinishedRequest] = []
-        self._step_idx += 1
+    def _grow_pages(self, idx: int, consumed: int) -> bool:
+        """Ensure slot ``idx`` owns every page its next ``consumed``
+        decode writes need (positions ``len .. len+consumed-1``) —
+        on-demand growth, one admission no longer pays max_new_tokens
+        upfront.  On allocation failure, preempt the youngest occupied
+        slot and retry; never the oldest.  Returns True when the slot can
+        decode this step (False: it was preempted itself, or stalled
+        because no victim remains — retried next step)."""
+        st = self._slots[idx]
+        need = self.pool.pages_for(int(self._len[idx]) + consumed) \
+            - len(st.pages)
+        while need > 0:
+            got = self.pool.alloc(need)
+            if got is not None:
+                row = self._table[idx]
+                row[len(st.pages):len(st.pages) + len(got)] = got
+                st.pages.extend(got)
+                return True
+            if self.pool.num_free + self.pool.num_reclaimable >= need:
+                # the pool COULD satisfy the lease, so the failure is a
+                # transient allocator fault (fault injection), not real
+                # pressure — stall this step rather than evict residents
+                # whose pages the retry won't even need
+                return False
+            victim = self._pick_victim()
+            if victim is None:
+                return False          # stalled; pool can't shrink further
+            self._preempt(victim)
+            if victim == idx:
+                return False          # the grower was the youngest itself
+        return True
 
+    def step(self) -> List[FinishedRequest]:
+        """One engine iteration: expire deadlines, admit into freed
+        slots, advance partial prefills by the chunk budget, grow decode
+        pages (preempting under pressure), then one decode step over
+        every started slot.  Returns every request that reached a
+        terminal state this step (including rejects/cancels recorded
+        since the last step).  Injected faults abort the remainder of the
+        iteration at a phase boundary; the next step resumes."""
+        t0 = time.perf_counter()
+        self._step_idx += 1
+        if self.faults is not None:
+            self.faults.begin_step(self._step_idx)
+        finished: List[FinishedRequest] = list(self._pending)
+        self._pending.clear()
+        try:
+            self._run_step(finished)
+        except InjectedFault:
+            self.stats["step_faults"] += 1
+        except BaseException:
+            # a REAL fault escaping mid-step must not swallow terminals
+            # already recorded this iteration (their pages are freed) —
+            # re-park them so a retrying host loop still delivers every
+            # request exactly one terminal state
+            self._pending = finished + self._pending
+            raise
+        dt = time.perf_counter() - t0
+        self.stats["pages_in_use"] = self.pool.pages_in_use
+        self.stats["queue_depth"] = self.scheduler.n_waiting
+        self.stats["step_wall_s"] += dt
+        self.stats["last_step_s"] = dt
+        return finished
+
+    def _run_step(self, finished: List[FinishedRequest]) -> None:
+        self._expire(finished)
         for adm in self.scheduler.schedule_step():
             self._admit(adm)
+        self._fault_point("admit")
         self._prefill_chunks(finished)
+        self._fault_point("prefill")
 
-        active = [i for i, s in enumerate(self._slots)
-                  if s is not None and s.started]
-        if active:
+        # decode-page growth, oldest first so preemption victims are
+        # always younger than the grower
+        order = sorted((i for i, s in enumerate(self._slots)
+                        if s is not None and s.started),
+                       key=lambda i: self._slots[i].seq)
+        run: List[int] = []
+        for idx in order:
+            if self._slots[idx] is None:      # preempted by an earlier grow
+                continue
+            st = self._slots[idx]
+            consumed = min(self.decode_block, st.request.remaining_new)
+            if self._grow_pages(idx, consumed):
+                run.append(idx)
+        if run:
             remaining = np.zeros((self.max_slots,), np.int32)
-            for idx in active:
-                st = self._slots[idx]
-                remaining[idx] = st.request.max_new_tokens - len(st.tokens)
+            for idx in run:
+                remaining[idx] = self._slots[idx].request.remaining_new
             self.pool.buffers, toks_all = self._decode_fn(
                 self.params, self.pool.buffers, jnp.asarray(self._tok),
                 jnp.asarray(self._len), jnp.asarray(self._table),
                 jnp.asarray(remaining), self._next_key())
             self.stats["decode_calls"] += 1
             toks_all = np.asarray(toks_all)                # (k, max_slots)
-            for idx in active:
+            for idx in run:
                 st = self._slots[idx]
                 consumed = int(min(self.decode_block, remaining[idx]))
                 reason = None
@@ -493,18 +768,16 @@ class ServingEngine:
                     # and its carry token is the last sampled one
                     self._tok[idx] = int(toks_all[consumed - 1, idx])
                     self._len[idx] += consumed
-        dt = time.perf_counter() - t0
-        self.stats["pages_in_use"] = self.pool.pages_in_use
-        self.stats["queue_depth"] = self.scheduler.n_waiting
-        self.stats["step_wall_s"] += dt
-        self.stats["last_step_s"] = dt
-        return finished
+        self._fault_point("decode")
 
     def check_invariants(self) -> None:
-        """Page-leak / refcount-consistency audit: the pool's internal
-        bookkeeping must balance, and the refcount total must equal the
-        page references live slots actually hold.  The serving tests'
-        conftest fixture calls this after every step."""
+        """Page-leak / refcount / scheduler-consistency audit.  The pool's
+        internal bookkeeping must balance, the refcount total must equal
+        the page references live slots actually hold (so anything waiting
+        — including preempted requests — provably holds ZERO pages), no
+        rid may be waiting and resident at once, and slot occupancy must
+        agree with the scheduler's free-slot list.  The serving tests'
+        conftest fixture calls this after every step and cancel."""
         self.pool.check()
         refs = sum(len(s.pages) for s in self._slots if s is not None)
         held = sum(self.pool.refcount)
@@ -512,11 +785,27 @@ class ServingEngine:
             raise AssertionError(
                 f"refcount sum {held} != {refs} page references held by "
                 "live slots — a page reference leaked or double-freed")
+        waiting_rids = [r.rid for r in self.scheduler.waiting]
+        if len(waiting_rids) != len(set(waiting_rids)):
+            raise AssertionError("duplicate rid in the waiting queue")
+        slot_rids = {s.request.rid for s in self._slots if s is not None}
+        both = set(waiting_rids) & slot_rids
+        if both:
+            raise AssertionError(
+                f"rid(s) {sorted(both)} simultaneously waiting and "
+                "resident in a slot")
+        free = set(self.scheduler._free_slots)
+        for i, s in enumerate(self._slots):
+            if (i in free) == (s is not None):
+                raise AssertionError(
+                    f"slot {i} occupancy disagrees with the scheduler's "
+                    "free-slot list")
 
     def run(self, requests: Optional[Sequence] = None
             ) -> Dict[int, FinishedRequest]:
         """Drive the host loop to completion over queued (+ given)
-        requests; returns {rid: FinishedRequest}."""
+        requests; returns {rid: FinishedRequest} — degraded terminals
+        (rejected/expired/cancelled) included."""
         for r in requests or ():
             if isinstance(r, Request):
                 self._enqueue(r)
@@ -527,7 +816,7 @@ class ServingEngine:
         while self.has_work:
             for fin in self.step():
                 done[fin.rid] = fin
-        # teardown: with every request finished the pool must be back at
+        # teardown: with every request terminal the pool must be back at
         # the cached-prefix-only baseline — any page still referenced by
         # a live slot (there are none) is a leak
         if self.scheduler.n_active or self.pool.pages_in_use:

@@ -79,6 +79,11 @@ class KVPool:
         self.refcount: List[int] = [0] * num_pages
         self.prefix: Optional[PrefixIndex] = (
             PrefixIndex(page_size) if prefix_cache else None)
+        # optional fault-injection plan (serving/faults.py): when set, a
+        # scripted step makes every alloc fail — the exact observable a
+        # real exhausted pool produces, so callers exercise their
+        # backoff/preemption paths deterministically
+        self.faults = None
 
     # -- allocation -------------------------------------------------------
 
@@ -121,6 +126,8 @@ class KVPool:
         (caller keeps the request queued — FCFS)."""
         if n_pages == 0:
             return []
+        if self.faults is not None and self.faults.fail_alloc():
+            return None
         if n_pages > len(self._free) and self.prefix is not None:
             for p in self.prefix.evict(n_pages - len(self._free),
                                        self.refcount):

@@ -144,6 +144,42 @@ class PrefixIndex:
             self._touch(node)
         return new
 
+    # -- snapshot (serving/snapshot.py) -----------------------------------
+
+    def to_state(self) -> dict:
+        """Plain-python capture of the whole tree: nodes in parent-first
+        (DFS) order as ``(parent_page, page, chunk, tick)``, with the
+        root named by page -1.  Everything numpy/int — picklable and
+        device-free."""
+        nodes = []
+
+        def walk(node: _Node) -> None:
+            for child in node.children.values():
+                nodes.append((node.page, child.page,
+                              np.asarray(child.chunk, np.int32).copy(),
+                              child.tick))
+                walk(child)
+
+        walk(self.root)
+        return {"page_size": self.page_size, "tick": self._tick,
+                "nodes": nodes}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "PrefixIndex":
+        """Rebuild an index from :meth:`to_state`.  Parent-first node
+        order means every parent exists before its children link in."""
+        idx = cls(state["page_size"])
+        by_page: Dict[int, _Node] = {-1: idx.root}
+        for parent_page, page, chunk, tick in state["nodes"]:
+            parent = by_page[int(parent_page)]
+            node = _Node(np.asarray(chunk, np.int32), int(page), parent)
+            node.tick = int(tick)
+            parent.children[node.chunk.tobytes()] = node
+            idx._by_page[node.page] = node
+            by_page[node.page] = node
+        idx._tick = int(state["tick"])
+        return idx
+
     # -- eviction ---------------------------------------------------------
 
     def evict(self, n_pages: int, refcount: Sequence[int]) -> List[int]:

@@ -16,20 +16,38 @@ stall every in-flight decode behind a monolithic prefill (the pre-r09
 failure mode that needed whole prompts force-admitted over budget).
 Admission is gated only by free slots and pages.
 
-Page accounting is conservative: a request is admitted only when the pool
-can hold its WHOLE worst-case sequence (prompt + max_new_tokens), so an
-admitted request can never die of page exhaustion mid-flight (no
-preemption/swap tier — requests are small relative to the pool; add
-eviction here if that stops holding).  Prefix-cached pages
-(kv_pool.KVPool ``prefix_cache=True``) are matched AT ADMISSION: shared
-full pages are retained instead of allocated, a partial-tail match is
-handed to the engine as a copy-on-write candidate, and only the uncached
-remainder allocates fresh pages.
+Page accounting is ON-DEMAND (r10, vLLM's preempt-by-recompute tier):
+admission reserves only the pages the PROMPT needs — decode grows the
+block table one page at a time as the sequence crosses page boundaries,
+and when growth fails the engine preempts the youngest occupied slot
+(its pages free, its generated tokens survive on the request, and
+:meth:`requeue` puts it back at the HEAD of the waiting queue for
+recompute-restart through the chunked-prefill path).  The pre-r10
+whole-lifetime reservation (``pages_for(total_len)`` at admission) paid
+``max_new_tokens`` worth of pages for every resident request whether
+generated or not; on-demand growth lifts occupancy at the cost of the
+preemption tier.  No-livelock: the OLDEST admitted request (smallest
+admission seq, preserved across preemptions) is never chosen as a
+victim, so it always progresses and the system always shrinks.
+Prefix-cached pages (kv_pool.KVPool ``prefix_cache=True``) are matched
+AT ADMISSION: shared full pages are retained instead of allocated, a
+partial-tail match is handed to the engine as a copy-on-write candidate,
+and only the uncached remainder allocates fresh pages — which is also
+what makes a preempted request's recompute cheap: its already-computed
+full prompt pages park reclaimable in the prefix index and are simply
+re-adopted at re-admission.
+
+Lifecycle (r10): a request may carry a ``deadline_s`` (seconds from
+enqueue, measured on the engine's clock) — :meth:`pop_expired` removes
+overdue requests at queue-pop time, the engine expires overdue slots
+per-step.  :meth:`remove_waiting` serves ``engine.cancel`` for queued
+requests.  The waiting queue itself stays a plain deque; the BOUND
+(backpressure) lives in the engine, which converts an over-limit enqueue
+into an explicit ``rejected`` terminal instead of unbounded growth.
 """
 
 from __future__ import annotations
 
-import itertools
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Tuple
@@ -38,17 +56,46 @@ import numpy as np
 
 from .kv_pool import KVPool
 
-_rid_counter = itertools.count()
+
+class _RidCounter:
+    """Monotonic request-id source.  A plain mutable counter (not
+    itertools.count) so snapshot/restore can capture and re-seed it —
+    restored engines must keep minting rids unique w.r.t. the snapshot."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def __call__(self) -> int:
+        rid, self.n = self.n, self.n + 1
+        return rid
 
 
-@dataclass
+_next_rid = _RidCounter()
+
+
+@dataclass(eq=False)
 class Request:
-    """One generation request: token ids in, up to ``max_new_tokens`` out."""
+    """One generation request: token ids in, up to ``max_new_tokens`` out.
+    Identity equality (``eq=False``): requests are stateful queue members
+    — field-wise comparison over numpy prompts is meaningless (and
+    ``deque.remove`` relies on ``==``).
+
+    ``deadline_s`` (optional) expires the request ``deadline_s`` engine-
+    clock seconds after enqueue, in ANY state.  ``generated`` holds every
+    token produced so far and SURVIVES preemption — a preempted request
+    re-enters the queue carrying its continuation, and the engine
+    re-prefills ``work_prompt`` (prompt + generated) before decoding the
+    remaining ``remaining_new`` tokens, so the final output is identical
+    to an unpreempted run under greedy sampling.
+    """
 
     prompt: np.ndarray
     max_new_tokens: int
-    rid: int = field(default_factory=lambda: next(_rid_counter))
+    rid: int = field(default_factory=_next_rid)
     arrival: float = 0.0
+    deadline_s: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -56,6 +103,15 @@ class Request:
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        # lifecycle state (not ctor args): tokens generated so far (kept
+        # across preemption), preemption count, enqueue timestamp on the
+        # engine's clock, and the admission seq — assigned at FIRST
+        # admission and preserved so the globally oldest request is never
+        # a preemption victim (the no-livelock guarantee).
+        self.generated: List[int] = []
+        self.n_preempted = 0
+        self.t_enqueue = 0.0
+        self.seq: Optional[int] = None
 
     @property
     def prompt_len(self) -> int:
@@ -63,7 +119,30 @@ class Request:
 
     @property
     def total_len(self) -> int:
+        """Worst-case positions ever needed — invariant under preemption
+        (``work_len + remaining_new`` is constant)."""
         return self.prompt_len + self.max_new_tokens
+
+    @property
+    def work_len(self) -> int:
+        """Positions needing K/V before the next decode: the original
+        prompt plus every token generated so far."""
+        return self.prompt_len + len(self.generated)
+
+    @property
+    def remaining_new(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    def work_prompt(self) -> np.ndarray:
+        """The token sequence to (re)prefill: prompt + generated."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def expired(self, now: float) -> bool:
+        return (self.deadline_s is not None
+                and now - self.t_enqueue > self.deadline_s)
 
 
 @dataclass
@@ -110,6 +189,31 @@ class FCFSScheduler:
         self.waiting.append(request)
         return request.rid
 
+    def requeue(self, request: Request) -> None:
+        """Put a PREEMPTED request back at the head of the queue: it was
+        admitted before anything still waiting, so FCFS order puts it in
+        front (multiple preemptions in one step requeue youngest-first,
+        each appendleft landing the older one ahead).  Bypasses the
+        engine's backpressure bound — the request was already accepted."""
+        self.waiting.appendleft(request)
+
+    def remove_waiting(self, rid: int) -> Optional[Request]:
+        """Remove and return the waiting request with ``rid`` (cancel),
+        or None if it is not queued."""
+        for req in self.waiting:
+            if req.rid == rid:
+                self.waiting.remove(req)
+                return req
+        return None
+
+    def pop_expired(self, now: float) -> List[Request]:
+        """Drop every waiting request whose deadline has passed (checked
+        at queue-pop time, before this step's admissions)."""
+        expired = [r for r in self.waiting if r.expired(now)]
+        for req in expired:
+            self.waiting.remove(req)
+        return expired
+
     @property
     def n_waiting(self) -> int:
         return len(self.waiting)
@@ -135,29 +239,33 @@ class FCFSScheduler:
         """Admit FCFS from the waiting queue into free slots until slots
         or pages run out.  Head-of-line blocking is intentional (FCFS
         fairness): if the HEAD's pages don't fit we stop, we don't scan
-        deeper for a smaller request.  Prefix-cache matching happens
-        here, while this step's page arithmetic is decided: matched full
-        pages are retained (shared) instead of allocated, and a
-        partial-tail match rides along as the COW candidate."""
+        deeper for a smaller request.  Page demand covers the WORK PROMPT
+        only (prompt + any preemption-survived tokens) — decode pages are
+        allocated on demand by the engine, which preempts under pressure.
+        Prefix-cache matching happens here, while this step's page
+        arithmetic is decided: matched full pages are retained (shared)
+        instead of allocated, and a partial-tail match rides along as the
+        COW candidate."""
         admissions: List[Admission] = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
+            work = req.work_prompt()
             cached: List[int] = []
             cow: Optional[Tuple[int, int]] = None
             held: List[int] = []
             if self.pool.prefix is not None:
                 # never match the whole prompt: the last token must be
                 # prefilled so its logits exist to sample the first output
-                cached, cow = self.pool.prefix.match(req.prompt[:-1])
+                cached, cow = self.pool.prefix.match(work[:-1])
                 held = list(cached) + ([cow[0]] if cow else [])
                 # pin matches BEFORE alloc — alloc may LRU-evict
                 # reclaimable cached pages to satisfy the fresh lease
                 self.pool.retain(held)
-            need = self.pool.pages_for(req.total_len) - len(cached)
+            need = self.pool.pages_for(req.work_len) - len(cached)
             pages = self.pool.alloc(need)
             if pages is None and cow is not None:
                 # the pinned COW source inflates peak demand by one page
-                # beyond the admission arithmetic (pages_for(total_len));
+                # beyond the admission arithmetic (pages_for(work_len));
                 # for a request sized to the remaining pool that ONE page
                 # can make alloc fail forever — drop the partial match
                 # (full-page matches only ever reduce demand) and retry

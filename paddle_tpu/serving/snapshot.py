@@ -1,0 +1,160 @@
+"""Engine snapshot / restore (r10) — resume a killed host loop exactly.
+
+The serving engine's device state is small and fully mirrored on the
+host: the page-pool buffers, the block tables, the per-slot carry
+token/length, and the RNG key.  That makes checkpointing the WHOLE
+engine cheap and exact — ``snapshot_engine`` captures
+
+  * the ctor config echo (slots, page size, sampling knobs, …),
+  * the scheduler's waiting queue and free-slot list,
+  * every occupied slot's metadata (request, pages, prefill progress),
+  * the pool: refcounts, free list, page buffers (as numpy), and the
+    full prefix-index radix tree,
+  * the host mirrors (``_tok``/``_len``/``_table``), the RNG key, step
+    and admission counters, stats, and any undelivered terminals,
+
+all as plain numpy/python (picklable, no live device references).
+``restore_engine(model, snap)`` rebuilds an engine around ``model`` —
+which must carry the SAME WEIGHTS as the snapshotted one (weights are
+deliberately not captured; they belong to the model checkpoint) — and
+resumes the host loop with token-for-token identical output
+(tests/test_serving.py::test_engine_snapshot_restore_exact).
+
+Heritage: the source Paddle fork ships training-side elasticity
+(``incubate/auto_checkpoint.py``); this is the serving-side analogue.
+
+Not captured: a ``FaultPlan`` (chaos schedules don't survive a restart)
+and the deadline clock — a restored engine defaults to
+``time.monotonic``, so pass ``clock=`` (or re-stamp deadlines) if the
+snapshot held deadline-bearing requests whose timebase must carry over.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .prefix_cache import PrefixIndex
+from . import scheduler as _sched
+from .scheduler import Request
+
+SNAPSHOT_VERSION = 1
+
+
+def _request_state(req: Request) -> dict:
+    return dict(prompt=np.asarray(req.prompt, np.int32).copy(),
+                max_new_tokens=int(req.max_new_tokens), rid=int(req.rid),
+                arrival=float(req.arrival), deadline_s=req.deadline_s,
+                t_enqueue=float(req.t_enqueue),
+                generated=list(req.generated),
+                n_preempted=int(req.n_preempted), seq=req.seq)
+
+
+def _request_from_state(st: dict) -> Request:
+    req = Request(prompt=st["prompt"], max_new_tokens=st["max_new_tokens"],
+                  rid=st["rid"], arrival=st["arrival"],
+                  deadline_s=st["deadline_s"])
+    req.t_enqueue = st["t_enqueue"]
+    req.generated = list(st["generated"])
+    req.n_preempted = st["n_preempted"]
+    req.seq = st["seq"]
+    return req
+
+
+def _finished_state(fin) -> dict:
+    return dict(rid=fin.rid, prompt=np.asarray(fin.prompt, np.int32).copy(),
+                tokens=np.asarray(fin.tokens, np.int32).copy(),
+                finish_reason=fin.finish_reason, n_steps=fin.n_steps)
+
+
+def snapshot_engine(eng) -> dict:
+    """Capture ``eng`` (a :class:`~paddle_tpu.serving.engine.ServingEngine`)
+    as a plain-python dict; see the module docstring for the contract."""
+    slots = []
+    for st in eng._slots:
+        if st is None:
+            slots.append(None)
+        else:
+            slots.append(dict(request=_request_state(st.request),
+                              pages=list(st.pages),
+                              prefilled=int(st.prefilled),
+                              started=bool(st.started), seq=int(st.seq),
+                              base_len=int(st.base_len),
+                              born_step=int(st.born_step)))
+    pool = eng.pool
+    return {
+        "version": SNAPSHOT_VERSION,
+        "config": dict(eng._config),
+        "engine": dict(
+            step_idx=int(eng._step_idx), admit_seq=int(eng._admit_seq),
+            key=np.asarray(eng._key).copy(), tok=eng._tok.copy(),
+            len=eng._len.copy(), table=eng._table.copy(),
+            stats=dict(eng.stats),
+            pending=[_finished_state(f) for f in eng._pending]),
+        "scheduler": dict(
+            waiting=[_request_state(r) for r in eng.scheduler.waiting],
+            free_slots=list(eng.scheduler._free_slots)),
+        "pool": dict(
+            refcount=list(pool.refcount), free=list(pool._free),
+            buffers={k: np.asarray(v).copy()
+                     for k, v in pool.buffers.items()},
+            prefix=(pool.prefix.to_state()
+                    if pool.prefix is not None else None)),
+        "slots": slots,
+        "rid_next": _sched._next_rid.n,
+    }
+
+
+def restore_engine(model, snap: dict, **overrides):
+    """Rebuild a ServingEngine around ``model`` from a
+    :func:`snapshot_engine` capture.  ``overrides`` patch ctor knobs
+    (e.g. ``clock=``); state-bearing knobs (slots, page size, pool size)
+    must match the snapshot or the mirrors won't fit."""
+    from .engine import FinishedRequest, ServingEngine, _Slot
+
+    if snap.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
+    cfg = dict(snap["config"])
+    cfg.update(overrides)
+    eng = ServingEngine(model, **cfg)
+
+    # rids must keep minting above anything the snapshot ever issued
+    _sched._next_rid.n = max(_sched._next_rid.n, int(snap["rid_next"]))
+
+    pool, ps = eng.pool, snap["pool"]
+    pool.refcount = list(ps["refcount"])
+    pool._free = list(ps["free"])
+    pool._free_set = set(pool._free)
+    pool.buffers = {k: jnp.asarray(v) for k, v in ps["buffers"].items()}
+    if ps["prefix"] is not None:
+        pool.prefix = PrefixIndex.from_state(ps["prefix"])
+
+    eng.scheduler.waiting.clear()
+    for rstate in snap["scheduler"]["waiting"]:
+        eng.scheduler.waiting.append(_request_from_state(rstate))
+    eng.scheduler._free_slots = list(snap["scheduler"]["free_slots"])
+
+    for idx, sstate in enumerate(snap["slots"]):
+        if sstate is None:
+            eng._slots[idx] = None
+            continue
+        req = _request_from_state(sstate["request"])
+        st = _Slot(req, list(sstate["pages"]),
+                   prefilled=sstate["prefilled"], seq=sstate["seq"],
+                   base_len=sstate["base_len"])
+        st.started = sstate["started"]
+        st.born_step = sstate["born_step"]
+        eng._slots[idx] = st
+
+    es = snap["engine"]
+    eng._step_idx = es["step_idx"]
+    eng._admit_seq = es["admit_seq"]
+    eng._key = jnp.asarray(es["key"])
+    eng._tok = np.asarray(es["tok"], np.int32).copy()
+    eng._len = np.asarray(es["len"], np.int32).copy()
+    eng._table = np.asarray(es["table"], np.int32).copy()
+    eng.stats.update(es["stats"])
+    eng._pending = [FinishedRequest(**f) for f in es["pending"]]
+    eng.check_invariants()
+    return eng

@@ -33,6 +33,11 @@ def pytest_configure(config):
         "markers",
         "slow: TPU-scale / long-running benches excluded from tier-1 "
         "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection runs against the serving engine "
+        "(tests/test_serving_faults.py) — deterministic, CPU-runnable, "
+        "included in tier-1")
 
 
 @pytest.fixture(autouse=True)
@@ -99,13 +104,20 @@ def _serving_page_leak_guard(monkeypatch):
         yield
         return
     orig_step = eng_mod.ServingEngine.step
+    orig_cancel = eng_mod.ServingEngine.cancel
 
     def checked_step(self):
         fins = orig_step(self)
         self.check_invariants()
         return fins
 
+    def checked_cancel(self, rid):
+        out = orig_cancel(self, rid)
+        self.check_invariants()
+        return out
+
     monkeypatch.setattr(eng_mod.ServingEngine, "step", checked_step)
+    monkeypatch.setattr(eng_mod.ServingEngine, "cancel", checked_cancel)
     yield
 
 

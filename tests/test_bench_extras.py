@@ -153,3 +153,44 @@ def test_prefix_serving_bench_tpu_scale():
                                       chunk_tokens=128, decode_block=8)
     assert res["cache"]["prefix_hit_rate"] > 0.0, res
     assert res["speedup"] >= 1.0, res
+
+
+def test_overload_serving_bench_smoke():
+    """Fast CPU smoke of the overload bench (ISSUE r10 satellite): the
+    calibration phase and both overload phases (bounded queue + deadlines
+    vs unbounded) complete, terminal accounting is total (completed +
+    rejected + expired covers every request in the bounded run), and the
+    unbounded control neither rejects nor expires."""
+    res = bench._overload_serving_bench(hidden=48, layers=2, heads=2,
+                                        vocab=128, n_requests=5,
+                                        max_slots=2, page_size=8,
+                                        prompt_len=8, new_tokens=8,
+                                        dtype="float32",
+                                        overload_factor=3.0,
+                                        decode_block=2)
+    assert res["at_capacity"]["goodput_tokens_per_sec"] > 0
+    b, u = res["overload_bounded"], res["overload_unbounded"]
+    n = res["config"]["n_requests"]
+    assert b["completed"] + round((b["reject_rate"] + b["expire_rate"]) * n) \
+        == n
+    assert u["reject_rate"] == 0.0 and u["expire_rate"] == 0.0
+    assert u["completed"] == n and u["goodput_tokens_per_sec"] > 0
+    assert res["config"]["deadline_s"] > 0
+    assert np.isfinite(res["goodput_ratio_bounded_vs_capacity"])
+
+
+@pytest.mark.slow
+def test_overload_serving_bench_tpu_scale():
+    """The flagship-sized overload point bench.py records on TPU (marked
+    slow).  The r10 acceptance bar lives here: with backpressure on
+    (bounded queue + deadlines), goodput under 3x-capacity overload stays
+    >= 0.9x the at-capacity goodput — load shedding keeps the engine
+    serving instead of drowning."""
+    res = bench._overload_serving_bench(hidden=1536, layers=24, heads=12,
+                                        vocab=50304, n_requests=48,
+                                        max_slots=8, page_size=64,
+                                        prompt_len=96, new_tokens=96,
+                                        dtype="bfloat16",
+                                        overload_factor=3.0,
+                                        decode_block=8)
+    assert res["goodput_ratio_bounded_vs_capacity"] >= 0.9, res

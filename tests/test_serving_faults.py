@@ -1,0 +1,204 @@
+"""Seeded fault-injection (chaos) suite for the serving engine (r10).
+
+The acceptance contract: under ANY seeded FaultPlan — scripted allocator
+exhaustion, mid-step exceptions at phase boundaries, virtual step
+latency blowing deadlines — every request reaches EXACTLY ONE terminal
+state ({eos, length} ∪ {rejected, expired, cancelled}), the engine's
+``check_invariants()`` holds after every step (the conftest autouse
+fixture enforces that), and a full drain leaves zero pages in use.
+
+Everything is deterministic: the plan is derived from one RNG seed on a
+virtual clock, so a failing seed replays bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import (TERMINAL_REASONS, FaultPlan, InjectedFault,
+                                ServingEngine)
+
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+           max_seq_len=96, dropout=0.0)
+
+
+def _model(seed=3):
+    paddle.seed(seed)
+    m = GPTForPretraining(GPTConfig(**CFG))
+    m.eval()
+    return m
+
+
+def test_fault_plan_seeded_deterministic():
+    """Same seed -> identical schedule; different seed -> (generically)
+    different.  The virtual clock advances by tick + scripted latency."""
+    a = FaultPlan.random(7, n_steps=50)
+    b = FaultPlan.random(7, n_steps=50)
+    assert a.alloc_fail_steps == b.alloc_fail_steps
+    assert a.raise_steps == b.raise_steps
+    assert a.latency_s == b.latency_s
+    c = FaultPlan.random(8, n_steps=50)
+    assert (a.alloc_fail_steps, a.raise_steps) != \
+        (c.alloc_fail_steps, c.raise_steps)
+    plan = FaultPlan(alloc_fail_steps={2}, raise_steps={3: "prefill"},
+                     latency_s={2: 0.5}, step_tick_s=0.001)
+    plan.begin_step(1)
+    assert not plan.fail_alloc() and plan.now() == pytest.approx(0.001)
+    plan.begin_step(2)
+    assert plan.fail_alloc() and plan.now() == pytest.approx(0.502)
+    plan.check_raise("prefill")           # wrong step: silent
+    plan.begin_step(3)
+    plan.check_raise("decode")            # wrong phase: silent
+    with pytest.raises(InjectedFault):
+        plan.check_raise("prefill")
+    assert plan.injected["alloc_fail"] == 1 and plan.injected["raise"] == 1
+    with pytest.raises(ValueError):
+        FaultPlan(raise_steps={1: "nonsense"})
+
+
+def test_injected_alloc_failure_defers_admission_leak_free():
+    """A scripted alloc-failure step simply defers admission (the request
+    stays queued) and a scripted exception skips the rest of that
+    iteration — no pages leak, outputs still complete."""
+    model = _model()
+    rng = np.random.RandomState(0)
+    p = rng.randint(0, 512, (6,)).astype("int32")
+    plan = FaultPlan(alloc_fail_steps={1, 2}, raise_steps={3: "admit"})
+    eng = ServingEngine(model, max_slots=2, page_size=8, faults=plan)
+    rid = eng.add_request(p, 4)
+    eng.step()                             # alloc fails: still waiting
+    assert eng.scheduler.n_waiting == 1 and eng.scheduler.n_active == 0
+    eng.step()
+    assert eng.scheduler.n_waiting == 1
+    eng.step()                             # admitted, then injected raise
+    assert eng.stats["step_faults"] == 1
+    assert eng.scheduler.n_active == 1     # admission committed cleanly
+    out = eng.run()
+    assert out[rid].reason == "length" and len(out[rid].tokens) == 4
+    assert eng.pool.pages_in_use == 0
+    assert plan.injected["alloc_fail"] >= 2 and plan.injected["raise"] == 1
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("mode,seed", [
+    ("fp_jnp", 0), ("fp_kernel", 0), ("int8_jnp", 1), ("int8_kernel", 2),
+])
+def test_chaos_terminal_totality_and_leak_freedom(mode, seed):
+    """Drive a mixed lifecycle load (staggered arrivals, tight + absent
+    deadlines, one mid-run cancel, a bounded queue) under a seeded
+    FaultPlan on fp/int8 × jnp/kernel paths.  Every request must end in
+    exactly one terminal state and the drained pool must hold zero
+    pages; the conftest fixture audits check_invariants() after every
+    step, including the preemption/cancel/fault steps."""
+    model = _model()
+    plan = FaultPlan.random(seed, n_steps=30, p_alloc=0.20, p_raise=0.12,
+                            p_latency=0.15, max_latency_s=0.01,
+                            step_tick_s=1e-3)
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=8,
+                        chunk_tokens=8, max_queue=3, faults=plan,
+                        int8="int8" in mode,
+                        use_paged_kernel="kernel" in mode)
+    rng = np.random.RandomState(100 + seed)
+
+    def make(deadline=None):
+        plen = int(rng.randint(3, 20))
+        new = int(rng.randint(3, 10))
+        return eng.add_request(rng.randint(0, 512, (plen,)).astype("int32"),
+                               new, deadline_s=deadline)
+
+    rids = [make(), make(0.015), make()]   # one tight deadline upfront
+    arrivals = {2: None, 4: 0.01, 6: None, 8: None, 10: 0.02}
+    terminals = {}
+    cancel_rid = rids[0]
+    steps = 0
+    while eng.has_work or steps < 12:
+        steps += 1
+        assert steps < 500, "chaos run failed to converge"
+        if steps in arrivals:
+            rids.append(make(arrivals[steps]))
+        if steps == 5:
+            eng.cancel(cancel_rid)         # may already be terminal: both ok
+        for fin in eng.step():
+            assert fin.rid not in terminals, \
+                f"rid {fin.rid} reached two terminal states"
+            terminals[fin.rid] = fin
+    assert set(terminals) == set(rids)
+    for fin in terminals.values():
+        assert fin.finish_reason in TERMINAL_REASONS
+        assert fin.reason == fin.finish_reason
+    # the plan really fired
+    assert plan.injected["alloc_fail"] + plan.injected["raise"] > 0
+    # drain-time leak freedom: nothing resident, nothing referenced
+    assert eng.scheduler.n_active == 0 and eng.scheduler.n_waiting == 0
+    assert eng.pool.pages_in_use == 0
+    eng.pool.check()
+    eng.check_invariants()
+    # stats ledger agrees with the observed terminals
+    from collections import Counter
+
+    by_reason = Counter(f.finish_reason for f in terminals.values())
+    assert by_reason["rejected"] == eng.stats["rejected"]
+    assert by_reason["expired"] == eng.stats["expired"]
+    assert by_reason["cancelled"] == eng.stats["cancelled"]
+
+
+def test_injected_growth_failure_stalls_without_cascade():
+    """An injected alloc failure during decode growth while the pool
+    still has free pages is a TRANSIENT fault, not pressure: the slot
+    stalls one step (no decode for it) instead of cascade-preempting
+    every younger resident, and decoding resumes next step with exact
+    tokens."""
+    from paddle_tpu.models.generation import build_generate_fn
+
+    model = _model()
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 512, (8,)).astype("int32") for _ in range(2)]
+    refs = [np.asarray(build_generate_fn(model, 12, greedy=True)(p[None])
+                       )[0, len(p):] for p in prompts]
+    # timeline: step 1 = admit + prefill + first growth (len 8) + decode;
+    # lengths then advance one per step, so the NEXT page boundary (len
+    # 16 -> a third page) lands in step 9 — script the fault there
+    plan = FaultPlan(alloc_fail_steps={9})
+    eng = ServingEngine(model, max_slots=2, page_size=8, faults=plan)
+    rids = [eng.add_request(p, 12) for p in prompts]
+    for _ in range(8):
+        eng.step()
+    pre = eng.stats["preemptions"]
+    decodes = eng.stats["decode_calls"]
+    eng.step()                            # growth hits the injected fault
+    assert plan.injected["alloc_fail"] >= 1
+    assert eng.stats["preemptions"] == pre      # NO cascade: free pages exist
+    assert eng.stats["decode_calls"] == decodes  # both slots stalled
+    assert eng.scheduler.n_active == 2          # both still resident
+    out = eng.run()
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(out[rid].tokens, ref)
+
+
+def test_real_fault_mid_step_reparks_terminals(monkeypatch):
+    """A REAL (non-injected) exception escaping mid-step must not lose
+    terminals already recorded in that iteration: they re-park in
+    _pending and the next step delivers them — terminal totality
+    survives a retrying host loop."""
+    model = _model()
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, 512, (4,)).astype("int32")
+    eng = ServingEngine(model, max_slots=1, page_size=8)
+    r1 = eng.add_request(p, 3)
+    r2 = eng.add_request(p.copy(), 3)
+    eng.cancel(r2)                         # terminal parked in _pending
+    orig = ServingEngine._run_step
+
+    def boom(self, finished):
+        orig(self, finished)
+        raise RuntimeError("device fell over")
+
+    monkeypatch.setattr(ServingEngine, "_run_step", boom)
+    with pytest.raises(RuntimeError):
+        eng.step()
+    monkeypatch.setattr(ServingEngine, "_run_step", orig)
+    out = eng.run()                        # retrying host loop
+    assert out[r2].reason == "cancelled"   # the parked terminal survived
+    assert out[r1].reason == "length" and len(out[r1].tokens) == 3
+    assert eng.pool.pages_in_use == 0
