@@ -25,6 +25,8 @@ CPU-runnable out of the box (tiny config); flags scale it up::
     python examples/serve_gpt.py --shared-prefix 32 --chunk-tokens 16
     python examples/serve_gpt.py --deadline-ms 500 --max-queue 4
     python examples/serve_gpt.py --inject-faults 7   # deterministic chaos
+    python examples/serve_gpt.py --metrics-dir /tmp/serve_metrics
+        # + TensorBoard scalars, metrics.prom, Perfetto trace.json (r11)
 """
 
 import argparse
@@ -70,6 +72,11 @@ def main():
     ap.add_argument("--inject-faults", type=int, default=None, metavar="SEED",
                     help="run under a seeded FaultPlan: scripted alloc "
                          "failures, step exceptions and virtual latency")
+    ap.add_argument("--metrics-dir", default=None, metavar="DIR",
+                    help="observe the run: TensorBoard scalars per step "
+                         "(tensorboard --logdir DIR), a Prometheus "
+                         "metrics.prom text dump, and a Chrome trace.json "
+                         "(open at https://ui.perfetto.dev) land in DIR")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -92,7 +99,16 @@ def main():
                         prefix_cache=not args.no_prefix_cache,
                         greedy=args.top_p >= 1.0, top_p=args.top_p,
                         eos_token_id=args.eos, int8=args.int8,
-                        max_queue=args.max_queue, faults=faults)
+                        max_queue=args.max_queue, faults=faults,
+                        metrics=args.metrics_dir is not None,
+                        trace=args.metrics_dir is not None)
+    exporter = None
+    if args.metrics_dir is not None:
+        from paddle_tpu.serving import MetricsFileExporter, attach_profiler
+
+        os.makedirs(args.metrics_dir, exist_ok=True)
+        exporter = MetricsFileExporter(eng.metrics, args.metrics_dir)
+        attach_profiler(eng.tracer)   # host RecordEvent spans join the trace
     print(f"engine: slots={args.slots} page_size={args.page_size} "
           f"pool={eng.pool.num_pages} pages "
           f"({eng.pool.hbm_bytes() / 1e6:.1f} MB) int8={args.int8}")
@@ -125,6 +141,8 @@ def main():
                   f"resident {fin.n_steps} steps) | "
                   f"pool util {eng.pool.utilization():.0%} | "
                   f"slots busy {occupancy}/{args.slots}")
+        if exporter is not None:
+            exporter.flush(step)
     dt = time.perf_counter() - t0
 
     s = eng.stats
@@ -149,6 +167,22 @@ def main():
               f"{faults.injected['raise']} injected exception(s), "
               f"{faults.injected['latency_s'] * 1e3:.1f}ms virtual latency "
               f"— pool drained leak-free: {eng.pool.pages_in_use == 0}")
+    if exporter is not None:
+        exporter.close()
+        trace_path = eng.tracer.save(
+            os.path.join(args.metrics_dir, "trace.json"))
+        sc = eng.metrics.scalars()
+        print(f"observability: TTFT p50/p99 "
+              f"{sc['serving_ttft_s_p50'] * 1e3:.1f}/"
+              f"{sc['serving_ttft_s_p99'] * 1e3:.1f}ms, "
+              f"TBT p50 {sc['serving_tbt_s_p50'] * 1e3:.1f}ms, "
+              f"queue wait p99 "
+              f"{sc['serving_queue_wait_s_p99'] * 1e3:.1f}ms")
+        print(f"  {len(sc)} scalar series -> tensorboard --logdir "
+              f"{args.metrics_dir}")
+        print(f"  Prometheus text dump -> {exporter.prom_path}")
+        print(f"  request/phase timeline -> {trace_path} "
+              f"(open at https://ui.perfetto.dev)")
     eng.check_invariants()
 
 

@@ -44,6 +44,14 @@ def main():
                         "int8 GEMMs with dynamic per-token activation "
                         "quant and an STE backward")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help="train-side observability (r11): loss / step "
+                        "time / tokens-per-sec / MFU through the serving "
+                        "MetricsRegistry — TensorBoard scalars per step "
+                        "plus a Prometheus metrics.prom dump in DIR")
+    p.add_argument("--peak-flops", type=float, default=197e12,
+                   help="per-chip peak FLOP/s for the MFU gauge "
+                        "(default: v5e bf16)")
     args = p.parse_args()
 
     import jax
@@ -94,21 +102,66 @@ def main():
         ids = mesh_mod.shard_batch(ids)
         labels = mesh_mod.shard_batch(labels)
 
+    exporter = None
+    if args.metrics_dir is not None:
+        # the serving registry doubles as the train-side metrics surface
+        # (ROADMAP item 4): same exponential histograms, same TB event
+        # files, same .prom dump — one observability substrate for both
+        # halves of the system
+        from paddle_tpu.serving.metrics import (MetricsFileExporter,
+                                                MetricsRegistry)
+
+        reg = MetricsRegistry()
+        m_loss = reg.gauge("train_loss", "cross-entropy at the step")
+        m_toks = reg.gauge("train_tokens_per_sec", "steady-state rate")
+        m_mfu = reg.gauge("train_mfu", "model FLOP utilization vs "
+                                       "--peak-flops")
+        m_steps = reg.counter("train_steps", "optimizer steps done")
+        m_step_s = reg.histogram("train_step_s", "train step wall time")
+        exporter = MetricsFileExporter(reg, args.metrics_dir)
+        # ~6ND forward+backward FLOPs/token (standard MFU numerator);
+        # the rate below counts the GLOBAL batch, so the denominator is
+        # per-chip peak x mesh size
+        flops_per_token = 6.0 * n_params
+        peak_total = args.peak_flops * max(need, 1)
+
     losses = []
     t0 = time.time()
+    t_step = t0
     for i in range(args.steps):
         params, opt_state, loss = step(params, opt_state, ids, labels)
         losses.append(float(np.asarray(loss)))
+        now = time.time()
         if i == 0:
-            t0 = time.time()  # exclude compile
+            t0 = now  # exclude compile
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:4d}  loss {losses[-1]:.4f}", flush=True)
+        if exporter is not None:
+            m_steps.inc()                  # every optimizer step counts
+            m_loss.set(losses[-1])
+            if i > 0:
+                # step 0 pays JIT compilation — keep it out of the
+                # step-time histogram and rate gauges (same post-warmup
+                # convention the serving benches use), matching the
+                # printed tokens/s which also excludes compile
+                dt = max(now - t_step, 1e-9)
+                rate = args.batch * seq / dt
+                m_toks.set(rate)
+                m_mfu.set(rate * flops_per_token / peak_total)
+                m_step_s.observe(dt)
+            exporter.flush(i)
+        t_step = now
     steps_timed = max(args.steps - 1, 1)
     tok_s = args.batch * seq * steps_timed / max(time.time() - t0, 1e-9)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], (losses[0], losses[-1])
     print(f"OK: loss {losses[0]:.4f} -> {losses[-1]:.4f}, "
           f"{tok_s:,.0f} tokens/s")
+    if exporter is not None:
+        exporter.close()
+        print(f"metrics: tensorboard --logdir {args.metrics_dir} "
+              f"({len(reg.scalars())} series); Prometheus dump "
+              f"{exporter.prom_path}")
 
 
 if __name__ == "__main__":

@@ -18,6 +18,15 @@ Composes four pieces:
     single decode step over the slot batch), backed by the Pallas
     paged-attention decode and paged-prefill chunk kernels
     (kernels/paged_attention.py, kernels/paged_prefill.py);
+  * observability (r11): dependency-free
+    :class:`~paddle_tpu.serving.metrics.MetricsRegistry` (counters /
+    gauges / exponential-bucket histograms with p50/p90/p99) fed by the
+    engine every step, per-request lifecycle tracing to Chrome
+    trace-event JSON (:mod:`~paddle_tpu.serving.tracing`, opens in
+    Perfetto, unified with ``profiler.RecordEvent`` host spans), and
+    TensorBoard + Prometheus file exporters
+    (``ServingEngine(metrics=..., trace=...)``,
+    ``engine.run(metrics_dir=...)``);
   * fault tolerance (r10): on-demand page growth with
     preempt-and-recompute under pool pressure, per-request deadlines /
     ``cancel`` / bounded-queue backpressure,
@@ -33,6 +42,10 @@ See README "Serving" for the architecture and knobs;
 from .kv_pool import KVPool
 from .prefix_cache import PrefixIndex
 from .scheduler import Admission, FCFSScheduler, Request
+from .metrics import (Counter, Gauge, Histogram, MetricsFileExporter,
+                      MetricsRegistry)
+from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, TraceRecorder,
+                      attach_profiler, detach_profiler)
 from .engine import TERMINAL_REASONS, FinishedRequest, ServingEngine
 from .faults import FaultPlan, InjectedFault
 from .snapshot import restore_engine, snapshot_engine
@@ -40,4 +53,7 @@ from .snapshot import restore_engine, snapshot_engine
 __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "ServingEngine", "FinishedRequest", "TERMINAL_REASONS",
            "FaultPlan", "InjectedFault", "snapshot_engine",
-           "restore_engine"]
+           "restore_engine", "MetricsRegistry", "Counter", "Gauge",
+           "Histogram", "MetricsFileExporter", "TraceRecorder",
+           "attach_profiler", "detach_profiler", "PID_ENGINE",
+           "PID_REQUESTS", "PID_HOST"]

@@ -78,6 +78,7 @@ over to per-page scales unchanged.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -97,7 +98,9 @@ from ..kernels import paged_attention as pa
 from ..kernels import paged_prefill as pp
 from .faults import FaultPlan, InjectedFault
 from .kv_pool import KVPool
+from .metrics import MetricsRegistry
 from .scheduler import FCFSScheduler, Request
+from .tracing import PID_ENGINE, PID_REQUESTS, TraceRecorder
 
 #: Reasons a request leaves the engine.  "eos"/"length" are successful
 #: completions; the r10 lifecycle adds the degraded terminals.
@@ -177,6 +180,19 @@ class ServingEngine:
     the deadline clock (a zero-arg callable returning seconds — defaults
     to the fault plan's virtual clock when one is set, else
     ``time.monotonic``).
+
+    r11 observability knobs: ``metrics`` feeds a
+    :class:`~paddle_tpu.serving.metrics.MetricsRegistry` every step
+    (pass a registry, or ``True`` to create one; ``None`` = off — the
+    hot loop then pays zero metric cost); ``trace`` records the
+    per-request lifecycle + engine step phases as Chrome trace-event
+    JSON (pass a :class:`~paddle_tpu.serving.tracing.TraceRecorder`, or
+    ``True`` to create one).  ``run(metrics_dir=...)`` exports both:
+    TensorBoard scalars per step, a ``metrics.prom`` Prometheus text
+    dump and ``trace.json`` (open in Perfetto) at drain.  Request-time
+    observations (queue wait, TTFT, time-between-tokens, e2e latency)
+    are measured on the ENGINE clock, so a FaultPlan's virtual clock
+    makes their histograms bit-deterministic.
     """
 
     def __init__(self, model, *, max_slots: int = 8, page_size: int = 32,
@@ -192,7 +208,8 @@ class ServingEngine:
                  chunk_tokens: int = 128, prefix_cache: bool = True,
                  max_queue: Optional[int] = None,
                  faults: Optional[FaultPlan] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 metrics=None, trace=None):
         cfg = model.cfg
         self.cfg = cfg
         # decode_block > 1 fuses that many decode steps into ONE dispatched
@@ -254,6 +271,8 @@ class ServingEngine:
             max_queue=max_queue)
 
         # host mirrors of the decode step's device operands
+        self._tokens_this_step = 0
+        self._phase_s: Dict[str, tuple] = {}
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._tok = np.zeros((max_slots,), np.int32)
         self._len = np.zeros((max_slots,), np.int32)
@@ -270,9 +289,28 @@ class ServingEngine:
                       "prefix_hit_tokens": 0, "prompt_tokens": 0,
                       "pages_in_use": 0, "queue_depth": 0,
                       "step_wall_s": 0.0, "last_step_s": 0.0,
+                      # per-phase wall time (r11): cumulative + last-step,
+                      # so admit/prefill/decode no longer conflate into
+                      # one step_wall_s bucket
+                      "admit_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0,
+                      "last_admit_s": 0.0, "last_prefill_s": 0.0,
+                      "last_decode_s": 0.0,
                       "preemptions": 0, "recompute_tokens": 0,
                       "rejected": 0, "expired": 0, "cancelled": 0,
                       "step_faults": 0}
+        # observability (r11): both default OFF — the hot loop pays
+        # nothing unless asked to measure itself
+        self.metrics: Optional[MetricsRegistry] = None
+        self._m = None
+        self.tracer: Optional[TraceRecorder] = None
+        # identity tests, not truthiness: an EMPTY registry is falsy
+        # (len 0) but still a registry the caller wants fed
+        if metrics is not None and metrics is not False:
+            self.attach_metrics(
+                metrics if isinstance(metrics, MetricsRegistry) else None)
+        if trace is not None and trace is not False:
+            self.attach_tracer(
+                trace if isinstance(trace, TraceRecorder) else None)
         self._decode_fn = self._build_decode()
         self._prefill_fn = self._build_prefill()
         self._cow_fn = self._build_cow()
@@ -445,12 +483,21 @@ class ServingEngine:
                 f"request needs {req.total_len} positions; engine "
                 f"max_seq_len is {self.max_seq_len}")
         req.t_enqueue = self._now()
+        if self.metrics is not None:
+            self._m["enqueued"].inc()
         if (self.max_queue is not None
                 and self.scheduler.n_waiting >= self.max_queue):
+            if self.tracer is not None:
+                self.tracer.begin("queued", PID_REQUESTS, req.rid)
             self.stats["rejected"] += 1
             self._pending.append(self._terminal(req, "rejected"))
             return req.rid
-        return self.scheduler.add(req)
+        rid = self.scheduler.add(req)
+        if self.tracer is not None:
+            self.tracer.begin("queued", PID_REQUESTS, req.rid,
+                              {"prompt_len": req.prompt_len,
+                               "max_new": req.max_new_tokens})
+        return rid
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request in ANY live state — waiting, mid-prefill or
@@ -478,6 +525,116 @@ class ServingEngine:
         """Fraction of prompt tokens served from cached KV pages."""
         return self.stats["prefix_hit_tokens"] / max(
             self.stats["prompt_tokens"], 1)
+
+    def stats_snapshot(self) -> Dict[str, float]:
+        """A COPY of the stats ledger at this instant.  ``engine.stats``
+        is the live mutable dict — callers that stash it see it keep
+        changing under them; read through this instead."""
+        return dict(self.stats)
+
+    # -- observability (r11) ----------------------------------------------
+
+    def attach_metrics(self, registry: Optional[MetricsRegistry] = None
+                       ) -> MetricsRegistry:
+        """Start feeding ``registry`` (fresh one if None) every step.
+        Benches attach AFTER their warmup run so compile time never
+        pollutes the measured histograms.  The registry must belong to
+        THIS engine alone: ``serving_*`` counters mirror this engine's
+        stats ledger via set_total, so a second feeding engine would
+        overwrite them, not add — aggregate replicas by summing their
+        registries' ``scalars()`` instead."""
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        c = self.metrics.counter
+        g = self.metrics.gauge
+        h = self.metrics.histogram
+        self._m = {
+            "enqueued": c("serving_requests_enqueued",
+                          "requests that arrived (incl. later rejects)"),
+            "terminal": {r: c(f"serving_requests_terminal_{r}",
+                              f"requests that ended {r}")
+                         for r in TERMINAL_REASONS},
+            "steps": c("serving_steps", "engine host-loop iterations"),
+            "tokens": c("serving_tokens_generated", "sampled tokens"),
+            "prefill_calls": c("serving_prefill_calls",
+                               "chunk-prefill dispatches"),
+            "decode_calls": c("serving_decode_calls", "decode dispatches"),
+            "preemptions": c("serving_preemptions",
+                             "slots evicted for recompute"),
+            "recompute": c("serving_recompute_tokens",
+                           "work-prompt tokens re-prefilled"),
+            "prefix_hit": c("serving_prefix_hit_tokens",
+                            "prompt tokens served from cached pages"),
+            "prompt_tokens": c("serving_prompt_tokens",
+                               "admitted work-prompt tokens"),
+            "cow": c("serving_cow_clones", "copy-on-write page clones"),
+            "step_faults": c("serving_step_faults",
+                             "injected mid-step exceptions absorbed"),
+            "alloc_calls": c("serving_alloc_calls",
+                             "KVPool.alloc lease attempts"),
+            "alloc_failures": c("serving_alloc_failures",
+                                "KVPool.alloc calls that returned None"),
+            "evictions": c("serving_prefix_evictions",
+                           "cached pages LRU-evicted under pressure"),
+            "pages_in_use": g("serving_pages_in_use",
+                              "pages referenced by live requests"),
+            "pages_free": g("serving_pages_free", "free-list pages"),
+            "pages_reclaimable": g("serving_pages_reclaimable",
+                                   "cached pages with no live reference"),
+            "queue_depth": g("serving_queue_depth", "waiting requests"),
+            "slots_active": g("serving_slots_active", "occupied slots"),
+            "hit_rate": g("serving_prefix_hit_rate",
+                          "prefix_hit_tokens / prompt_tokens"),
+            "budget_util": g("serving_token_budget_utilization",
+                             "step tokens / token_budget"),
+            "queue_wait": h("serving_queue_wait_s",
+                            "enqueue -> first admission (engine clock)"),
+            "ttft": h("serving_ttft_s",
+                      "enqueue -> first token (engine clock)"),
+            "tbt": h("serving_tbt_s",
+                     "time between tokens per slot (engine clock)"),
+            "e2e": h("serving_e2e_latency_s",
+                     "enqueue -> terminal (engine clock)"),
+            "step_s": h("serving_step_s", "full step wall time"),
+            "admit_s": h("serving_step_admit_s",
+                         "expire+admit phase wall time"),
+            "prefill_s": h("serving_step_prefill_s",
+                           "chunk-prefill phase wall time"),
+            "decode_s": h("serving_step_decode_s",
+                          "grow+decode phase wall time"),
+            "chunk_s": h("serving_prefill_chunk_s",
+                         "one chunk-prefill dispatch wall time"),
+            "decode_call_s": h("serving_decode_call_s",
+                               "one decode dispatch+sync wall time"),
+        }
+        return self.metrics
+
+    def attach_tracer(self, tracer: Optional[TraceRecorder] = None
+                      ) -> TraceRecorder:
+        """Start recording the request lifecycle + engine phases as
+        Chrome trace events (fresh recorder if None)."""
+        self.tracer = tracer if tracer is not None else TraceRecorder()
+        self.tracer.process_name(PID_ENGINE, "serving engine (step phases)")
+        self.tracer.process_name(PID_REQUESTS, "requests (tid = rid)")
+        return self.tracer
+
+    def _tr_end(self, rid: int, args: Optional[dict] = None) -> None:
+        """Close the request's open span, tolerating a tracer attached
+        mid-lifecycle (no span open yet)."""
+        if self.tracer.open_span(PID_REQUESTS, rid) is not None:
+            self.tracer.end(PID_REQUESTS, rid, args)
+
+    def _observe_terminal(self, req: Request, reason: str) -> None:
+        """Single funnel for EVERY FinishedRequest creation: terminal
+        counters here are exactly one inc per terminal, which is what
+        lets the chaos suite assert registry == observed terminals."""
+        if self.metrics is not None:
+            self._m["terminal"][reason].inc()
+            self._m["e2e"].observe(self._now() - req.t_enqueue)
+        if self.tracer is not None:
+            self._tr_end(req.rid)
+            self.tracer.instant(reason, PID_REQUESTS, req.rid,
+                                {"rid": req.rid,
+                                 "tokens": len(req.generated)})
 
     def snapshot(self) -> dict:
         """Capture the whole engine state (queue, slots, pool, prefix
@@ -513,6 +670,7 @@ class ServingEngine:
         """Terminal record for a request that is NOT in a slot (waiting
         or rejected at enqueue) — generated tokens from any earlier
         residency ride along."""
+        self._observe_terminal(req, reason)
         return FinishedRequest(
             rid=req.rid, prompt=req.prompt,
             tokens=np.asarray(req.generated, np.int32),
@@ -525,6 +683,7 @@ class ServingEngine:
         self._tok[idx] = 0
         self._len[idx] = 0
         self.scheduler.release(idx, st.pages)
+        self._observe_terminal(st.request, reason)
         return FinishedRequest(
             rid=st.request.rid, prompt=st.request.prompt,
             tokens=np.asarray(st.tokens, np.int32), finish_reason=reason,
@@ -545,6 +704,13 @@ class ServingEngine:
         st.request.n_preempted += 1
         self.scheduler.requeue(st.request)
         self.stats["preemptions"] += 1
+        if self.tracer is not None:
+            rid = st.request.rid
+            self._tr_end(rid)            # the "resident" span
+            self.tracer.instant("preempt", PID_REQUESTS, rid,
+                                {"generated": len(st.request.generated)})
+            self.tracer.begin("queued", PID_REQUESTS, rid,
+                              {"recompute": True})
 
     def _pick_victim(self) -> Optional[int]:
         """The youngest occupied slot (largest admission seq) — unless it
@@ -598,6 +764,23 @@ class ServingEngine:
         if req.n_preempted > 0:
             # the uncached remainder of the work prompt is recomputation
             self.stats["recompute_tokens"] += req.work_len - adm.matched
+        now = self._now()
+        if self.metrics is not None:
+            if req.t_admitted is None:        # first admission only: a
+                # re-admission after preemption is not queue wait
+                self._m["queue_wait"].observe(now - req.t_enqueue)
+            if adm.cow is not None:
+                self._m["cow"].inc()
+        if req.t_admitted is None:
+            req.t_admitted = now
+        if self.tracer is not None:
+            self._tr_end(req.rid)             # the "queued" span
+            if adm.cow is not None:
+                self.tracer.instant("cow_clone", PID_REQUESTS, req.rid,
+                                    {"matched_tokens": adm.cow[1]})
+            self.tracer.begin("resident", PID_REQUESTS, req.rid,
+                              {"slot": idx, "matched": adm.matched,
+                               "preempted": req.n_preempted})
 
     def _prefill_chunks(self, finished: List[FinishedRequest]) -> None:
         """Spend the step's chunk budget FCFS over partially-prefilled
@@ -614,7 +797,8 @@ class ServingEngine:
             key=lambda i: self._slots[i].seq)
         for idx in partial:
             st = self._slots[idx]
-            work = st.request.work_prompt()
+            req = st.request
+            work = req.work_prompt()
             while budget > 0 and not st.started:
                 n = min(st.base_len - st.prefilled, budget,
                         self.chunk_tokens)
@@ -622,14 +806,24 @@ class ServingEngine:
                             max(self.chunk_tokens, n))
                 toks = np.zeros((c_pad,), np.int32)
                 toks[:n] = work[st.prefilled:st.prefilled + n]
+                if self.tracer is not None:
+                    self.tracer.begin("prefill_chunk", PID_REQUESTS,
+                                      req.rid, {"start": st.prefilled,
+                                                "n": n})
+                t_c = time.perf_counter()
                 self.pool.buffers, tok = self._prefill_fn(
                     self.params, self.pool.buffers, jnp.asarray(toks),
                     jnp.int32(st.prefilled), jnp.int32(n),
                     jnp.asarray(self._table[idx]), jnp.int32(n - 1),
                     self._next_key())
+                if self.metrics is not None:
+                    self._m["chunk_s"].observe(time.perf_counter() - t_c)
+                if self.tracer is not None:
+                    self.tracer.end(PID_REQUESTS, req.rid)
                 self.stats["prefill_calls"] += 1
                 st.prefilled += n
                 budget -= n
+                self._tokens_this_step += n
                 if st.prefilled < st.base_len:
                     continue
                 # prompt complete: next token sampled; its full pages
@@ -641,6 +835,20 @@ class ServingEngine:
                 tok = int(tok)
                 st.tokens.append(tok)
                 self.stats["tokens_generated"] += 1
+                now = self._now()
+                if req.t_first_token is None:
+                    if self.metrics is not None:
+                        self._m["ttft"].observe(now - req.t_enqueue)
+                    if self.tracer is not None:
+                        self.tracer.instant("first_token", PID_REQUESTS,
+                                            req.rid)
+                    req.t_first_token = now
+                elif self.metrics is not None and req.t_last_token is not None:
+                    # a recomputed request's first post-readmission token:
+                    # the gap since its last delivered token is real
+                    # user-visible inter-token stall
+                    self._m["tbt"].observe(now - req.t_last_token)
+                req.t_last_token = now
                 self._tok[idx] = tok
                 self._len[idx] = st.base_len
                 if (self.eos_token_id is not None
@@ -697,6 +905,12 @@ class ServingEngine:
             self.faults.begin_step(self._step_idx)
         finished: List[FinishedRequest] = list(self._pending)
         self._pending.clear()
+        self._tokens_this_step = 0
+        # phase -> (start perf-seconds, duration); filled by _run_step's
+        # finally blocks, so a fault aborting a phase still records the
+        # time it burned before aborting.  Carried on the instance (not a
+        # parameter) so _run_step keeps its r10 signature.
+        phase = self._phase_s = {}
         try:
             self._run_step(finished)
         except InjectedFault:
@@ -713,16 +927,78 @@ class ServingEngine:
         self.stats["queue_depth"] = self.scheduler.n_waiting
         self.stats["step_wall_s"] += dt
         self.stats["last_step_s"] = dt
+        for ph in ("admit", "prefill", "decode"):
+            start_dur = phase.get(ph)
+            v = start_dur[1] if start_dur is not None else 0.0
+            self.stats[f"{ph}_s"] += v
+            self.stats[f"last_{ph}_s"] = v
+        if self.tracer is not None:
+            for ph, (start, dur) in phase.items():
+                self.tracer.complete(ph, start, dur, PID_ENGINE, 0,
+                                     {"step": self._step_idx})
+        if self.metrics is not None:
+            self._sync_metrics(dt, phase)
         return finished
 
-    def _run_step(self, finished: List[FinishedRequest]) -> None:
-        self._expire(finished)
-        for adm in self.scheduler.schedule_step():
-            self._admit(adm)
-        self._fault_point("admit")
-        self._prefill_chunks(finished)
-        self._fault_point("prefill")
+    def _sync_metrics(self, dt: float, phase: Dict[str, tuple]) -> None:
+        """End-of-step registry feed: monotonic counters sync from the
+        stats ledger (one source of truth — they cannot diverge), gauges
+        sample the pool/scheduler, histograms take this step's wall
+        times.  Terminal counters and request-time histograms are fed
+        inline at their event sites instead."""
+        m, s = self._m, self.stats
+        m["steps"].inc()
+        for stat_key, name in (("tokens_generated", "tokens"),
+                               ("prefill_calls", "prefill_calls"),
+                               ("decode_calls", "decode_calls"),
+                               ("preemptions", "preemptions"),
+                               ("recompute_tokens", "recompute"),
+                               ("prefix_hit_tokens", "prefix_hit"),
+                               ("prompt_tokens", "prompt_tokens"),
+                               ("step_faults", "step_faults")):
+            m[name].set_total(s[stat_key])
+        m["alloc_calls"].set_total(self.pool.alloc_calls)
+        m["alloc_failures"].set_total(self.pool.alloc_failures)
+        if self.pool.prefix is not None:
+            m["evictions"].set_total(self.pool.prefix.evictions)
+        m["pages_in_use"].set(self.pool.pages_in_use)
+        m["pages_free"].set(self.pool.num_free)
+        m["pages_reclaimable"].set(self.pool.num_reclaimable)
+        m["queue_depth"].set(self.scheduler.n_waiting)
+        m["slots_active"].set(self.scheduler.n_active)
+        m["hit_rate"].set(self.prefix_hit_rate())
+        m["budget_util"].set(self._tokens_this_step
+                             / max(self.scheduler.token_budget, 1))
+        m["step_s"].observe(dt)
+        for ph in ("admit", "prefill", "decode"):
+            if ph in phase:
+                m[f"{ph}_s"].observe(phase[ph][1])
 
+    def _run_step(self, finished: List[FinishedRequest]) -> None:
+        phase = self._phase_s
+        t_a = time.perf_counter()
+        try:
+            self._expire(finished)
+            for adm in self.scheduler.schedule_step():
+                self._admit(adm)
+            self._fault_point("admit")
+        finally:
+            phase["admit"] = (t_a, time.perf_counter() - t_a)
+        t_p = time.perf_counter()
+        try:
+            self._prefill_chunks(finished)
+            self._fault_point("prefill")
+        finally:
+            phase["prefill"] = (t_p, time.perf_counter() - t_p)
+
+        t_d = time.perf_counter()
+        try:
+            self._decode_step(finished)
+            self._fault_point("decode")
+        finally:
+            phase["decode"] = (t_d, time.perf_counter() - t_d)
+
+    def _decode_step(self, finished: List[FinishedRequest]) -> None:
         # decode-page growth, oldest first so preemption victims are
         # always younger than the grower
         order = sorted((i for i, s in enumerate(self._slots)
@@ -740,24 +1016,38 @@ class ServingEngine:
             remaining = np.zeros((self.max_slots,), np.int32)
             for idx in run:
                 remaining[idx] = self._slots[idx].request.remaining_new
+            t_c = time.perf_counter()
             self.pool.buffers, toks_all = self._decode_fn(
                 self.params, self.pool.buffers, jnp.asarray(self._tok),
                 jnp.asarray(self._len), jnp.asarray(self._table),
                 jnp.asarray(remaining), self._next_key())
             self.stats["decode_calls"] += 1
             toks_all = np.asarray(toks_all)                # (k, max_slots)
+            if self.metrics is not None:
+                # np.asarray synced the dispatch, so this is the real
+                # device step time, not the async hand-off
+                self._m["decode_call_s"].observe(time.perf_counter() - t_c)
+            now = self._now()
             for idx in run:
                 st = self._slots[idx]
                 consumed = int(min(self.decode_block, remaining[idx]))
                 reason = None
+                n_new = 0
                 for i in range(consumed):
                     tok = int(toks_all[i, idx])
                     st.tokens.append(tok)
+                    n_new += 1
                     self.stats["tokens_generated"] += 1
                     if (self.eos_token_id is not None
                             and tok == self.eos_token_id):
                         reason = "eos"
                         break
+                self._tokens_this_step += n_new
+                req = st.request
+                if (self.metrics is not None and n_new
+                        and req.t_last_token is not None):
+                    self._m["tbt"].observe((now - req.t_last_token) / n_new)
+                req.t_last_token = now
                 if reason is None and (len(st.tokens)
                                        >= st.request.max_new_tokens):
                     reason = "length"
@@ -768,7 +1058,6 @@ class ServingEngine:
                     # and its carry token is the last sampled one
                     self._tok[idx] = int(toks_all[consumed - 1, idx])
                     self._len[idx] += consumed
-        self._fault_point("decode")
 
     def check_invariants(self) -> None:
         """Page-leak / refcount / scheduler-consistency audit.  The pool's
@@ -801,21 +1090,53 @@ class ServingEngine:
                     f"slot {i} occupancy disagrees with the scheduler's "
                     "free-slot list")
 
-    def run(self, requests: Optional[Sequence] = None
+    def run(self, requests: Optional[Sequence] = None,
+            metrics_dir: Optional[str] = None, flush_every: int = 1
             ) -> Dict[int, FinishedRequest]:
         """Drive the host loop to completion over queued (+ given)
         requests; returns {rid: FinishedRequest} — degraded terminals
-        (rejected/expired/cancelled) included."""
+        (rejected/expired/cancelled) included.
+
+        ``metrics_dir`` turns the drain into an observed run: every
+        ``flush_every`` steps the registry's scalars flush to a
+        TensorBoard event file under the dir (auto-attaching metrics —
+        and a tracer when none is set — if needed), and at drain the dir
+        additionally holds ``metrics.prom`` (Prometheus text exposition)
+        and ``trace.json`` (Chrome trace events, open in Perfetto)."""
+        from .metrics import MetricsFileExporter
+
         for r in requests or ():
             if isinstance(r, Request):
                 self._enqueue(r)
             else:
                 prompt, max_new = r
                 self.add_request(prompt, max_new)
+        exporter = None
+        if metrics_dir is not None:
+            if self.metrics is None:
+                self.attach_metrics()
+            if self.tracer is None:
+                self.attach_tracer()
+            os.makedirs(metrics_dir, exist_ok=True)
+            exporter = MetricsFileExporter(self.metrics, metrics_dir)
         done: Dict[int, FinishedRequest] = {}
-        while self.has_work:
-            for fin in self.step():
-                done[fin.rid] = fin
+        try:
+            while self.has_work:
+                for fin in self.step():
+                    done[fin.rid] = fin
+                if exporter is not None and \
+                        self._step_idx % flush_every == 0:
+                    exporter.flush(self._step_idx)
+        finally:
+            if exporter is not None:
+                if exporter.last_step != self._step_idx:
+                    # flush_every > 1: the tail steps (or a whole run
+                    # shorter than the interval) still reach the file
+                    exporter.flush(self._step_idx)
+                exporter.close()
+                if self.tracer is not None:
+                    self.tracer.save(
+                        os.path.join(metrics_dir, "trace.json"))
         # teardown: with every request terminal the pool must be back at
         # the cached-prefix-only baseline — any page still referenced by
         # a live slot (there are none) is a leak

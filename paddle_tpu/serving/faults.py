@@ -26,7 +26,10 @@ Wiring (chosen so no fault can land at an inconsistent point):
     ``step_tick_s`` plus any scripted per-step latency at
     ``begin_step``; an engine built with a plan reads deadlines off that
     clock, so expiry under slowdown is reproducible and test-fast (no
-    real sleeping).
+    real sleeping).  The same clock drives the engine's request-time
+    METRICS (queue-wait / TTFT / TBT / e2e histograms,
+    serving/metrics.py), so under a plan those readouts are
+    bit-deterministic — asserted by the chaos suite.
 
 The chaos acceptance contract (tests/test_serving_faults.py): under ANY
 seeded plan every request reaches exactly one terminal state

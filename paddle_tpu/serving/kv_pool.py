@@ -84,6 +84,11 @@ class KVPool:
         # real exhausted pool produces, so callers exercise their
         # backoff/preemption paths deterministically
         self.faults = None
+        # allocator traffic counters (r11): the engine mirrors these into
+        # its metrics registry each step — alloc-failure rate is the
+        # earliest pressure signal an operator sees
+        self.alloc_calls = 0
+        self.alloc_failures = 0
 
     # -- allocation -------------------------------------------------------
 
@@ -126,13 +131,16 @@ class KVPool:
         (caller keeps the request queued — FCFS)."""
         if n_pages == 0:
             return []
+        self.alloc_calls += 1
         if self.faults is not None and self.faults.fail_alloc():
+            self.alloc_failures += 1
             return None
         if n_pages > len(self._free) and self.prefix is not None:
             for p in self.prefix.evict(n_pages - len(self._free),
                                        self.refcount):
                 self._push_free(p)
         if n_pages > len(self._free):
+            self.alloc_failures += 1
             return None
         got = []
         for _ in range(n_pages):

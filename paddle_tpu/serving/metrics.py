@@ -1,0 +1,350 @@
+"""Dependency-free metrics registry for the serving engine (r11).
+
+The engine's ``stats`` dict is a flat ledger read once at drain time —
+good enough for a test assertion, useless for operating a service: you
+cannot route on a number you only see after the load is gone.  ROADMAP
+items 1 (multi-replica routing) and 4 (SLO-aware scheduling) both need
+per-request TTFT / time-between-token percentiles and queue/pool
+time-series to make decisions on.  This module is that substrate,
+hand-rolled on stdlib only (the serving package's no-new-imports
+contract — ``tests/test_metrics.py`` guards it):
+
+  * :class:`Counter` — monotonic totals (terminals, preemptions,
+    tokens);
+  * :class:`Gauge` — point-in-time levels (pool occupancy, queue
+    depth, budget utilization);
+  * :class:`Histogram` — exponential ("log-linear") buckets with
+    p50/p90/p99 readout, the same shape Prometheus client libraries use
+    for latency: fixed memory, O(1) observe, quantiles by linear
+    interpolation within the straddling bucket.  Exact min/max/sum ride
+    along so readouts stay honest at small counts;
+  * :class:`MetricsRegistry` — the namespace: get-or-create by name,
+    ``scalars()`` flattens everything (histograms expand to
+    ``_count/_sum/_mean/_min/_max/_p50/_p90/_p99``) for the TensorBoard
+    exporter, ``to_prometheus()`` emits the text exposition format
+    (cumulative ``_bucket{le=...}`` lines), ``to_state()`` /
+    ``from_state()`` make metrics survive engine snapshot/restore.
+
+Exporters (both file-based, both dependency-free):
+
+  * :class:`MetricsFileExporter` — periodic scalar flush through the
+    hand-rolled :class:`~paddle_tpu.utils.tensorboard.SummaryWriter`
+    (one tag per scalar, ``step`` = engine step; ``tensorboard
+    --logdir`` opens it directly) plus a Prometheus ``metrics.prom``
+    text dump on close — the node-exporter "textfile collector" shape,
+    so a real scrape pipeline picks it up without the engine growing an
+    HTTP server.
+
+Determinism: time-valued observations fed from the engine's injectable
+clock (``serving/faults.py``'s virtual clock under a FaultPlan) make
+histogram readouts bit-reproducible across chaos runs — asserted in
+tests/test_serving_faults.py.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "MetricsFileExporter"]
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return out if out and not out[0].isdigit() else "_" + out
+
+
+class Counter:
+    """Monotonic counter.  ``set_total`` exists ONLY for mirror-sync and
+    snapshot-restore (the engine keeps some counters in lockstep with its
+    ``stats`` ledger); user code should ``inc``."""
+
+    __slots__ = ("name", "help", "value")
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def set_total(self, v: float) -> None:
+        self.value = float(v)
+
+    def scalars(self) -> Dict[str, float]:
+        return {self.name: self.value}
+
+    def to_state(self) -> dict:
+        return {"kind": self.kind, "help": self.help, "value": self.value}
+
+    def load_state(self, st: dict) -> None:
+        self.value = float(st["value"])
+
+
+class Gauge:
+    """Point-in-time level; ``set`` replaces, ``inc``/``dec`` adjust."""
+
+    __slots__ = ("name", "help", "value")
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.value -= n
+
+    def scalars(self) -> Dict[str, float]:
+        return {self.name: self.value}
+
+    def to_state(self) -> dict:
+        return {"kind": self.kind, "help": self.help, "value": self.value}
+
+    def load_state(self, st: dict) -> None:
+        self.value = float(st["value"])
+
+
+class Histogram:
+    """Exponential-bucket histogram with quantile readout.
+
+    Bucket upper bounds grow geometrically: ``start * factor**i`` for
+    ``n_buckets`` finite buckets plus the +Inf overflow — the default
+    (100µs .. ~28min at factor 2) covers every latency the engine can
+    produce, with ~2x relative quantile error (one factor step), tight
+    enough to schedule on.  ``quantile`` finds the straddling bucket by
+    cumulative rank and interpolates linearly inside it, clamped to the
+    exact observed min/max so tiny samples don't report a bound nobody
+    measured.
+    """
+
+    __slots__ = ("name", "help", "bounds", "counts", "count", "sum",
+                 "min", "max")
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", start: float = 1e-4,
+                 factor: float = 2.0, n_buckets: int = 24):
+        if start <= 0 or factor <= 1.0 or n_buckets < 1:
+            raise ValueError("need start > 0, factor > 1, n_buckets >= 1")
+        self.name = name
+        self.help = help
+        self.bounds: List[float] = [start * factor ** i
+                                    for i in range(n_buckets)]
+        self.counts: List[int] = [0] * (n_buckets + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.counts[bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def quantile(self, q: float) -> float:
+        """q in [0, 1].  0.0 with no observations (a readout, not NaN)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank and c > 0:
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.max)
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                # linear interpolation of the rank within the bucket
+                frac = 1.0 - (cum - rank) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def scalars(self) -> Dict[str, float]:
+        n = self.name
+        return {f"{n}_count": float(self.count), f"{n}_sum": self.sum,
+                f"{n}_mean": self.mean,
+                f"{n}_min": self.min if self.min is not None else 0.0,
+                f"{n}_max": self.max if self.max is not None else 0.0,
+                f"{n}_p50": self.quantile(0.50),
+                f"{n}_p90": self.quantile(0.90),
+                f"{n}_p99": self.quantile(0.99)}
+
+    def to_state(self) -> dict:
+        return {"kind": self.kind, "help": self.help,
+                "bounds": list(self.bounds), "counts": list(self.counts),
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+    def load_state(self, st: dict) -> None:
+        self.bounds = [float(b) for b in st["bounds"]]
+        self.counts = [int(c) for c in st["counts"]]
+        self.count = int(st["count"])
+        self.sum = float(st["sum"])
+        self.min = st["min"]
+        self.max = st["max"]
+
+
+class MetricsRegistry:
+    """Get-or-create namespace of metrics, one per name.
+
+    Re-requesting a name returns the SAME instance (a second caller
+    asking for a different kind under an existing name is a programming
+    error and raises) — so code observing ONE engine (its scheduler, a
+    bench harness, a train loop using its own ``train_*`` names) can
+    feed one registry without coordination.
+
+    One engine per registry: the engine keeps its ``serving_*`` counters
+    in lockstep with its stats ledger via ``set_total``, so TWO engines
+    sharing a registry would overwrite each other's mirrored totals
+    (last stepper wins) instead of aggregating.  Give each engine its
+    own registry and sum ``scalars()`` downstream — that is the
+    multi-replica aggregation shape (ROADMAP item 1).
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._metrics
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def _get_or_create(self, cls, name, help, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, help=help, **kw)
+            self._metrics[name] = m
+        elif not isinstance(m, cls):
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "", start: float = 1e-4,
+                  factor: float = 2.0, n_buckets: int = 24) -> Histogram:
+        return self._get_or_create(Histogram, name, help, start=start,
+                                   factor=factor, n_buckets=n_buckets)
+
+    # -- readouts ---------------------------------------------------------
+
+    def scalars(self) -> Dict[str, float]:
+        """Every metric flattened to {tag: float} — the TensorBoard /
+        bench-JSON surface.  Histograms expand to 8 derived tags."""
+        out: Dict[str, float] = {}
+        for m in self._metrics.values():
+            out.update(m.scalars())
+        return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition format (one scrape page).  Histograms emit the
+        standard cumulative ``_bucket{le="..."}`` series + ``_sum`` +
+        ``_count``; +Inf is always present and equals ``_count``."""
+        lines: List[str] = []
+        for m in self._metrics.values():
+            name = _sanitize(m.name)
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                cum = 0
+                for bound, c in zip(m.bounds, m.counts):
+                    cum += c
+                    lines.append(
+                        f'{name}_bucket{{le="{bound:.6g}"}} {cum}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{name}_sum {m.sum:.9g}")
+                lines.append(f"{name}_count {m.count}")
+            else:
+                v = m.value
+                lines.append(f"{name} {int(v) if v == int(v) else v}")
+        return "\n".join(lines) + "\n"
+
+    # -- snapshot (serving/snapshot.py) -----------------------------------
+
+    def to_state(self) -> dict:
+        return {name: m.to_state() for name, m in self._metrics.items()}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "MetricsRegistry":
+        reg = cls()
+        kinds = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+        for name, st in state.items():
+            m = kinds[st["kind"]](name, help=st.get("help", ""))
+            m.load_state(st)
+            reg._metrics[name] = m
+        return reg
+
+
+class MetricsFileExporter:
+    """TensorBoard scalar time-series + Prometheus textfile dump.
+
+    ``flush(step)`` writes every ``registry.scalars()`` tag at ``step``
+    into an event file under ``out_dir`` (open with ``tensorboard
+    --logdir out_dir``); ``close()`` writes the final scrape page to
+    ``out_dir/metrics.prom`` (Prometheus node-exporter textfile-collector
+    format) and closes the event file.  Context-manager friendly.
+    """
+
+    def __init__(self, registry: MetricsRegistry, out_dir: str,
+                 prom_name: str = "metrics.prom"):
+        from ..utils.tensorboard import SummaryWriter
+
+        self.registry = registry
+        self.out_dir = out_dir
+        self.prom_path = os.path.join(out_dir, prom_name)
+        self.writer = SummaryWriter(out_dir)
+        self.last_step = -1
+
+    def flush(self, step: int) -> None:
+        self.last_step = step
+        for tag, v in self.registry.scalars().items():
+            if math.isfinite(v):
+                self.writer.add_scalar(tag, v, step=step)
+        self.writer.flush()
+
+    def dump_prometheus(self) -> str:
+        text = self.registry.to_prometheus()
+        with open(self.prom_path, "w") as f:
+            f.write(text)
+        return self.prom_path
+
+    def close(self) -> None:
+        self.dump_prometheus()
+        self.writer.close()
+
+    def __enter__(self) -> "MetricsFileExporter":
+        return self
+
+    def __exit__(self, *a) -> None:
+        self.close()

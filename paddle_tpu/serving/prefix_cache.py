@@ -54,6 +54,10 @@ class PrefixIndex:
         self.root = _Node(None, -1, None)
         self._by_page: Dict[int, _Node] = {}
         self._tick = 0
+        # lifetime eviction count (r11): cache-churn observable the
+        # engine mirrors into its metrics registry — rising evictions at
+        # a flat hit rate means the working set outgrew the pool
+        self.evictions = 0
 
     def __len__(self) -> int:
         return len(self._by_page)
@@ -162,7 +166,7 @@ class PrefixIndex:
 
         walk(self.root)
         return {"page_size": self.page_size, "tick": self._tick,
-                "nodes": nodes}
+                "nodes": nodes, "evictions": self.evictions}
 
     @classmethod
     def from_state(cls, state: dict) -> "PrefixIndex":
@@ -178,6 +182,7 @@ class PrefixIndex:
             idx._by_page[node.page] = node
             by_page[node.page] = node
         idx._tick = int(state["tick"])
+        idx.evictions = int(state.get("evictions", 0))
         return idx
 
     # -- eviction ---------------------------------------------------------
@@ -205,4 +210,5 @@ class PrefixIndex:
                 del node.parent.children[node.chunk.tobytes()]
                 del self._by_page[node.page]
                 out.append(node.page)
+        self.evictions += len(out)
         return out

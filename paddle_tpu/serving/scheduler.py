@@ -112,6 +112,14 @@ class Request:
         self.n_preempted = 0
         self.t_enqueue = 0.0
         self.seq: Optional[int] = None
+        # observability timestamps (engine clock, r11): first admission,
+        # first token ever sampled, last token delivered — the engine
+        # derives queue-wait / TTFT / time-between-token histograms from
+        # these; all survive preemption (a recomputed request keeps its
+        # original TTFT) and snapshot/restore.
+        self.t_admitted: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:

@@ -12,6 +12,10 @@ engine cheap and exact — ``snapshot_engine`` captures
     full prefix-index radix tree,
   * the host mirrors (``_tok``/``_len``/``_table``), the RNG key, step
     and admission counters, stats, and any undelivered terminals,
+  * the metrics registry (r11, when attached): counters, gauges and
+    histogram buckets restore so the time-series stays monotonic across
+    a restart (the tracer does NOT snapshot — a trace is an artifact of
+    one process's timeline, like the FaultPlan),
 
 all as plain numpy/python (picklable, no live device references).
 ``restore_engine(model, snap)`` rebuilds an engine around ``model`` —
@@ -24,9 +28,12 @@ Heritage: the source Paddle fork ships training-side elasticity
 (``incubate/auto_checkpoint.py``); this is the serving-side analogue.
 
 Not captured: a ``FaultPlan`` (chaos schedules don't survive a restart)
-and the deadline clock — a restored engine defaults to
-``time.monotonic``, so pass ``clock=`` (or re-stamp deadlines) if the
-snapshot held deadline-bearing requests whose timebase must carry over.
+and the deadline clock itself — a restored engine defaults to
+``time.monotonic``.  The snapshot DOES record the engine clock's reading
+at capture time, and restore rebases every request timestamp onto the
+new clock (r11): relative intervals are preserved, so deadline-bearing
+requests resume with their remaining budget and the latency histograms
+never observe a cross-process monotonic base jump.
 """
 
 from __future__ import annotations
@@ -39,7 +46,7 @@ from .prefix_cache import PrefixIndex
 from . import scheduler as _sched
 from .scheduler import Request
 
-SNAPSHOT_VERSION = 1
+SNAPSHOT_VERSION = 2
 
 
 def _request_state(req: Request) -> dict:
@@ -48,7 +55,10 @@ def _request_state(req: Request) -> dict:
                 arrival=float(req.arrival), deadline_s=req.deadline_s,
                 t_enqueue=float(req.t_enqueue),
                 generated=list(req.generated),
-                n_preempted=int(req.n_preempted), seq=req.seq)
+                n_preempted=int(req.n_preempted), seq=req.seq,
+                t_admitted=req.t_admitted,
+                t_first_token=req.t_first_token,
+                t_last_token=req.t_last_token)
 
 
 def _request_from_state(st: dict) -> Request:
@@ -59,6 +69,9 @@ def _request_from_state(st: dict) -> Request:
     req.generated = list(st["generated"])
     req.n_preempted = st["n_preempted"]
     req.seq = st["seq"]
+    req.t_admitted = st.get("t_admitted")
+    req.t_first_token = st.get("t_first_token")
+    req.t_last_token = st.get("t_last_token")
     return req
 
 
@@ -91,18 +104,31 @@ def snapshot_engine(eng) -> dict:
             key=np.asarray(eng._key).copy(), tok=eng._tok.copy(),
             len=eng._len.copy(), table=eng._table.copy(),
             stats=dict(eng.stats),
+            # the engine clock's reading AT SNAPSHOT: restore rebases
+            # every request timestamp onto the new process's clock, so
+            # deadline budgets and latency observations carry relative
+            # intervals over — raw time.monotonic values are meaningless
+            # across a process boundary (per-boot base)
+            clock_now=float(eng._now()),
             pending=[_finished_state(f) for f in eng._pending]),
         "scheduler": dict(
             waiting=[_request_state(r) for r in eng.scheduler.waiting],
             free_slots=list(eng.scheduler._free_slots)),
         "pool": dict(
             refcount=list(pool.refcount), free=list(pool._free),
+            alloc_calls=int(pool.alloc_calls),
+            alloc_failures=int(pool.alloc_failures),
             buffers={k: np.asarray(v).copy()
                      for k, v in pool.buffers.items()},
             prefix=(pool.prefix.to_state()
                     if pool.prefix is not None else None)),
         "slots": slots,
         "rid_next": _sched._next_rid.n,
+        # metrics ride along (r11): a restored engine's registry resumes
+        # counting where the snapshot left off — counters stay monotonic
+        # and histograms keep their observations across a restart
+        "metrics": (eng.metrics.to_state()
+                    if eng.metrics is not None else None),
     }
 
 
@@ -126,6 +152,8 @@ def restore_engine(model, snap: dict, **overrides):
     pool.refcount = list(ps["refcount"])
     pool._free = list(ps["free"])
     pool._free_set = set(pool._free)
+    pool.alloc_calls = int(ps.get("alloc_calls", 0))
+    pool.alloc_failures = int(ps.get("alloc_failures", 0))
     pool.buffers = {k: jnp.asarray(v) for k, v in ps["buffers"].items()}
     if ps["prefix"] is not None:
         pool.prefix = PrefixIndex.from_state(ps["prefix"])
@@ -134,6 +162,23 @@ def restore_engine(model, snap: dict, **overrides):
     for rstate in snap["scheduler"]["waiting"]:
         eng.scheduler.waiting.append(_request_from_state(rstate))
     eng.scheduler._free_slots = list(snap["scheduler"]["free_slots"])
+
+    # rebase request timestamps from the snapshotted clock onto this
+    # engine's clock: shifted values preserve every relative interval
+    # (elapsed-before-snapshot + elapsed-after-restore), so deadlines
+    # keep their remaining budget and the latency histograms never see
+    # a cross-process monotonic base jump (possibly negative durations)
+    delta = eng._now() - float(snap["engine"]["clock_now"])
+
+    def _rebase(req: Request) -> None:
+        req.t_enqueue += delta
+        for attr in ("t_admitted", "t_first_token", "t_last_token"):
+            v = getattr(req, attr)
+            if v is not None:
+                setattr(req, attr, v + delta)
+
+    for req in eng.scheduler.waiting:
+        _rebase(req)
 
     for idx, sstate in enumerate(snap["slots"]):
         if sstate is None:
@@ -145,6 +190,7 @@ def restore_engine(model, snap: dict, **overrides):
                    base_len=sstate["base_len"])
         st.started = sstate["started"]
         st.born_step = sstate["born_step"]
+        _rebase(req)
         eng._slots[idx] = st
 
     es = snap["engine"]
@@ -156,5 +202,9 @@ def restore_engine(model, snap: dict, **overrides):
     eng._table = np.asarray(es["table"], np.int32).copy()
     eng.stats.update(es["stats"])
     eng._pending = [FinishedRequest(**f) for f in es["pending"]]
+    if snap.get("metrics") is not None and "metrics" not in overrides:
+        from .metrics import MetricsRegistry
+
+        eng.attach_metrics(MetricsRegistry.from_state(snap["metrics"]))
     eng.check_invariants()
     return eng

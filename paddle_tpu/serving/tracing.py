@@ -1,0 +1,176 @@
+"""Request-lifecycle tracing: Chrome trace-event JSON from the engine.
+
+Role parity: the reference fork's profiler pairs host ``RecordEvent``
+span tables with a CUPTI ``DeviceTracer`` whose output opens in
+``chrome://tracing`` (PAPER.md, ``platform/profiler.h``).  Our serving
+engine had neither: a request's life — queued, admitted, chunk-prefilled,
+decoding, preempted, recomputed, terminal — happened invisibly inside
+the host loop.  This module records it as Chrome trace-event JSON
+(the ``{"traceEvents": [...]}`` format), openable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``:
+
+  * **one track per request** (pid ``PID_REQUESTS``, tid = rid):
+    ``queued`` and ``resident`` B/E spans, ``prefill_chunk`` spans per
+    chunk, instants for ``first_token`` / ``preempt`` / ``cow_clone`` /
+    the terminal reason — a preempted request visibly bounces back to a
+    ``queued`` span and re-prefills;
+  * **one engine track** (pid ``PID_ENGINE``): per-step ``admit`` /
+    ``prefill`` / ``decode`` phase X (complete) events, so slow steps
+    and fault-aborted phases line up against request state;
+  * **host spans** (pid ``PID_HOST``): :func:`attach_profiler` bridges
+    ``paddle_tpu.profiler.RecordEvent`` — every host span recorded
+    anywhere in-process lands on the SAME timeline as the engine
+    phases, the unification the reference gets from one profiler state.
+
+B/E discipline: :meth:`TraceRecorder.end` pops the recorder's own
+per-track stack and names the E event from it, so emitted B/E pairs are
+balanced BY CONSTRUCTION inside a track (asserted over chaos runs in
+tests/test_metrics.py).  Engine phases deliberately use X events — an
+injected mid-phase fault can abort a phase, and an X event written after
+the fact cannot dangle.
+
+Timestamps are microseconds on one monotonic base (``time.perf_counter``
+by default; injectable for tests).  Dump with :meth:`save` and load the
+file straight into Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["TraceRecorder", "PID_ENGINE", "PID_REQUESTS", "PID_HOST",
+           "attach_profiler", "detach_profiler"]
+
+#: Process lanes of the unified timeline.
+PID_ENGINE = 1      # engine step phases (admit/prefill/decode X events)
+PID_REQUESTS = 2    # one thread per request (tid = rid)
+PID_HOST = 3        # profiler.RecordEvent host spans
+
+
+class TraceRecorder:
+    """Append-only Chrome trace-event recorder.
+
+    ``clock`` is a zero-arg seconds source (default
+    ``time.perf_counter``); every event stamps ``ts`` in microseconds
+    relative to the recorder's construction, so traces start at t=0.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._clock = clock or time.perf_counter
+        self._t0 = self._clock()
+        self.events: List[dict] = []
+        # open-span stack per (pid, tid): end() pops, so B/E pairs are
+        # balanced by construction within a track
+        self._open: Dict[tuple, List[str]] = {}
+        self._named_pids = set()
+
+    # -- time -------------------------------------------------------------
+
+    def now_us(self) -> float:
+        return (self._clock() - self._t0) * 1e6
+
+    def _ev(self, name: str, ph: str, ts: float, pid: int, tid: int,
+            args: Optional[dict] = None, **extra) -> dict:
+        ev = {"name": name, "ph": ph, "ts": round(ts, 3),
+              "pid": pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        ev.update(extra)
+        self.events.append(ev)
+        return ev
+
+    def process_name(self, pid: int, name: str) -> None:
+        """Label a pid lane (idempotent) — Perfetto shows the name."""
+        if pid not in self._named_pids:
+            self._named_pids.add(pid)
+            self._ev("process_name", "M", 0.0, pid, 0,
+                     args={"name": name})
+
+    # -- spans ------------------------------------------------------------
+
+    def begin(self, name: str, pid: int, tid: int,
+              args: Optional[dict] = None) -> None:
+        self._open.setdefault((pid, tid), []).append(name)
+        self._ev(name, "B", self.now_us(), pid, tid, args)
+
+    def end(self, pid: int, tid: int, args: Optional[dict] = None) -> str:
+        """Close the innermost open span on (pid, tid); returns its name.
+        A track with nothing open raises — the engine's lifecycle logic
+        is the state machine, and an unmatched end means it broke."""
+        stack = self._open.get((pid, tid))
+        if not stack:
+            raise ValueError(f"no open span on track ({pid}, {tid})")
+        name = stack.pop()
+        self._ev(name, "E", self.now_us(), pid, tid, args)
+        return name
+
+    def open_span(self, pid: int, tid: int) -> Optional[str]:
+        """Name of the innermost open span on the track, or None."""
+        stack = self._open.get((pid, tid))
+        return stack[-1] if stack else None
+
+    def instant(self, name: str, pid: int, tid: int,
+                args: Optional[dict] = None) -> None:
+        self._ev(name, "i", self.now_us(), pid, tid, args, s="t")
+
+    def complete(self, name: str, start_s: float, dur_s: float, pid: int,
+                 tid: int, args: Optional[dict] = None) -> None:
+        """An X event from absolute clock seconds (same base as
+        ``clock``) — used for engine phases and bridged host spans."""
+        self._ev(name, "X", (start_s - self._t0) * 1e6, pid, tid, args,
+                 dur=round(dur_s * 1e6, 3))
+
+    # -- output -----------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {"traceEvents": list(self.events),
+                "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f)
+        return path
+
+
+# -- profiler bridge ---------------------------------------------------------
+
+def attach_profiler(tracer: TraceRecorder, pid: int = PID_HOST,
+                    tid: int = 0):
+    """Mirror every ``profiler.RecordEvent`` span into ``tracer`` as an X
+    event on the host lane — engine phases, request lifecycle and host
+    spans land on ONE Perfetto timeline.  Returns the sink handle for
+    :func:`detach_profiler`; callers who outlive the tracer should
+    detach, or the module-global sink list keeps feeding (and growing)
+    a dead trace.  Idempotent per tracer: re-attaching an
+    already-bridged tracer returns the existing sink instead of
+    doubling every span.  RecordEvent measures on
+    ``time.perf_counter``; the recorder maps those stamps through its
+    own t0, so alignment with engine phases is exact when the tracer
+    runs on the default clock (and merely monotonic under a virtual
+    test clock)."""
+    from .. import profiler as _prof
+
+    existing = getattr(tracer, "_profiler_sink", None)
+    if existing is not None:
+        return existing
+    tracer.process_name(pid, "host (profiler.RecordEvent)")
+
+    def sink(name: str, t0: float, t1: float) -> None:
+        tracer.complete(name, t0, t1 - t0, pid, tid)
+
+    sink.tracer = tracer
+    tracer._profiler_sink = sink
+    _prof.add_span_sink(sink)
+    return sink
+
+
+def detach_profiler(sink) -> None:
+    from .. import profiler as _prof
+
+    _prof.remove_span_sink(sink)
+    tracer = getattr(sink, "tracer", None)
+    if tracer is not None and \
+            getattr(tracer, "_profiler_sink", None) is sink:
+        tracer._profiler_sink = None

@@ -25,7 +25,7 @@ import time
 
 from ..onnx.proto import f_bytes, f_string, f_varint
 
-__all__ = ["SummaryWriter"]
+__all__ = ["SummaryWriter", "read_events", "read_scalars"]
 
 # -- crc32c (Castagnoli, table-driven) --------------------------------------
 
@@ -92,6 +92,79 @@ def _event(wall_time: float, step: int = 0, file_version: str = None,
 def _scalar_summary(tag: str, value: float) -> bytes:
     val = f_string(1, tag) + _f_float32(2, value)
     return f_bytes(1, val)
+
+
+# -- reader ------------------------------------------------------------------
+#
+# The writer above framed records for years with nothing checking its own
+# output beyond "tensorboard opens it".  This reader closes the loop: it
+# deframes TFRecords VERIFYING both masked CRCs (a corrupt byte fails
+# loudly instead of skewing a chart) and parses the Event/Summary protos
+# with the repo's own proto reader — write scalars, read back
+# (tag, step, value), asserted in tests/test_tensorboard_hdfs.py.
+
+
+def read_events(path: str) -> list:
+    """Deframe one event file into raw Event dicts
+    ``{wall_time, step, file_version, summary}`` (``summary`` is the
+    still-encoded Summary message or None).  Raises ``ValueError`` on a
+    truncated record or a CRC mismatch."""
+    from ..onnx.proto import parse_message
+
+    with open(path, "rb") as f:
+        raw = f.read()
+    out, pos = [], 0
+    while pos < len(raw):
+        if pos + 12 > len(raw):
+            raise ValueError(f"truncated record header at byte {pos}")
+        (ln,) = struct.unpack_from("<Q", raw, pos)
+        (lcrc,) = struct.unpack_from("<I", raw, pos + 8)
+        if lcrc != _masked_crc(raw[pos:pos + 8]):
+            raise ValueError(f"length CRC mismatch at byte {pos}")
+        if pos + 12 + ln + 4 > len(raw):
+            raise ValueError(f"truncated record payload at byte {pos}")
+        payload = raw[pos + 12:pos + 12 + ln]
+        (pcrc,) = struct.unpack_from("<I", raw, pos + 12 + ln)
+        if pcrc != _masked_crc(payload):
+            raise ValueError(f"payload CRC mismatch at byte {pos}")
+        pos += 12 + ln + 4
+        msg = parse_message(payload)
+        out.append({
+            "wall_time": msg.get(1, [0.0])[0],
+            "step": int(msg.get(2, [0])[0]),
+            "file_version": (msg[3][0].decode()
+                             if 3 in msg else None),
+            "summary": msg.get(5, [None])[0],
+        })
+    return out
+
+
+def read_scalars(path_or_dir: str) -> dict:
+    """Read every scalar out of an event file — or out of every
+    ``events.out.tfevents.*`` under a log dir — as
+    ``{tag: [(step, value), ...]}`` in write order."""
+    from ..onnx.proto import parse_message
+
+    if os.path.isdir(path_or_dir):
+        paths = sorted(
+            os.path.join(path_or_dir, f) for f in os.listdir(path_or_dir)
+            if f.startswith("events.out.tfevents."))
+    else:
+        paths = [path_or_dir]
+    out: dict = {}
+    for path in paths:
+        for ev in read_events(path):
+            if ev["summary"] is None:
+                continue
+            summ = parse_message(ev["summary"])
+            for val_msg in summ.get(1, []):
+                val = parse_message(val_msg)
+                if 1 not in val or 2 not in val:
+                    continue      # not a simple_value summary
+                tag = val[1][0].decode()
+                out.setdefault(tag, []).append(
+                    (ev["step"], float(val[2][0])))
+    return out
 
 
 class SummaryWriter:

@@ -90,6 +90,16 @@ def test_serving_bench_smoke():
     assert res["engine"]["decode_steps"] > 0
     assert np.isfinite(res["speedup"])
     assert res["config"]["useful_tokens"] > 0
+    # r11 satellite: the engine leg carries the registry's machine-
+    # readable metrics dict, consistent with the bench's own report
+    m = res["engine"]["metrics"]
+    assert m["serving_decode_calls"] == res["engine"]["decode_steps"]
+    assert m["serving_tokens_generated"] > 0
+    assert m["serving_ttft_s_count"] == res["config"]["n_requests"]
+    assert m["serving_ttft_s_p99"] >= m["serving_ttft_s_p50"] > 0
+    assert sum(m[f"serving_requests_terminal_{r}"]
+               for r in ("eos", "length", "rejected", "expired",
+                         "cancelled")) == res["config"]["n_requests"]
 
 
 def test_serving_bench_poisson_arrivals():
@@ -123,6 +133,29 @@ def test_prefix_serving_bench_smoke():
     assert res["cache"]["prefill_calls"] < res["no_cache"]["prefill_calls"]
     assert np.isfinite(res["speedup"])
     assert res["config"]["useful_tokens"] == 4 * 6
+    # r11: per-leg registry dicts agree with the legs' own reports
+    assert res["cache"]["metrics"]["serving_prefix_hit_tokens"] > 0
+    assert res["no_cache"]["metrics"]["serving_prefix_hit_tokens"] == 0
+    for leg in ("cache", "no_cache"):
+        assert (res[leg]["metrics"]["serving_prefill_calls"]
+                == res[leg]["prefill_calls"])
+
+
+def test_metrics_overhead_bench_smoke():
+    """r11 acceptance point: the metrics-on engine completes the same
+    load as the metrics-off engine and reports a sane goodput ratio.
+    The < 2% bar is asserted loosely here (CPU CI timing noise on a
+    sub-second run dwarfs the real registry cost); bench.py records the
+    honest number on quiet hardware."""
+    res = bench._metrics_overhead_bench(hidden=48, layers=2, heads=2,
+                                        vocab=128, n_requests=8,
+                                        max_slots=2, page_size=8,
+                                        prompt_len=8, new_tokens=12,
+                                        dtype="float32")
+    assert res["off_tokens_per_sec"] > 0
+    assert res["on_tokens_per_sec"] > 0
+    assert res["on_off_ratio"] > 0.5       # noise guard, not the 2% bar
+    assert res["config"]["n_requests"] == 8
 
 
 @pytest.mark.slow

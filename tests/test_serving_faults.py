@@ -80,6 +80,37 @@ def test_injected_alloc_failure_defers_admission_leak_free():
     assert plan.injected["alloc_fail"] >= 2 and plan.injected["raise"] == 1
 
 
+def _drive_chaos_load(eng, rng, arrivals, cancel_step=5, min_steps=12):
+    """The ONE chaos load script both chaos suites drive: 3 upfront
+    requests (one with a tight deadline), staggered extra arrivals by
+    step index, a mid-run cancel of the first rid (which may already be
+    terminal — both outcomes are legal).  Asserts convergence and
+    terminal totality/uniqueness; returns (rids, {rid: FinishedRequest})
+    in arrival order."""
+    def make(deadline=None):
+        plen = int(rng.randint(3, 20))
+        new = int(rng.randint(3, 10))
+        return eng.add_request(rng.randint(0, 512, (plen,)).astype("int32"),
+                               new, deadline_s=deadline)
+
+    rids = [make(), make(0.015), make()]   # one tight deadline upfront
+    terminals = {}
+    steps = 0
+    while eng.has_work or steps < min_steps:
+        steps += 1
+        assert steps < 500, "chaos run failed to converge"
+        if steps in arrivals:
+            rids.append(make(arrivals[steps]))
+        if steps == cancel_step:
+            eng.cancel(rids[0])
+        for fin in eng.step():
+            assert fin.rid not in terminals, \
+                f"rid {fin.rid} reached two terminal states"
+            terminals[fin.rid] = fin
+    assert set(terminals) == set(rids)
+    return rids, terminals
+
+
 @pytest.mark.chaos
 @pytest.mark.parametrize("mode,seed", [
     ("fp_jnp", 0), ("fp_kernel", 0), ("int8_jnp", 1), ("int8_kernel", 2),
@@ -100,30 +131,8 @@ def test_chaos_terminal_totality_and_leak_freedom(mode, seed):
                         int8="int8" in mode,
                         use_paged_kernel="kernel" in mode)
     rng = np.random.RandomState(100 + seed)
-
-    def make(deadline=None):
-        plen = int(rng.randint(3, 20))
-        new = int(rng.randint(3, 10))
-        return eng.add_request(rng.randint(0, 512, (plen,)).astype("int32"),
-                               new, deadline_s=deadline)
-
-    rids = [make(), make(0.015), make()]   # one tight deadline upfront
-    arrivals = {2: None, 4: 0.01, 6: None, 8: None, 10: 0.02}
-    terminals = {}
-    cancel_rid = rids[0]
-    steps = 0
-    while eng.has_work or steps < 12:
-        steps += 1
-        assert steps < 500, "chaos run failed to converge"
-        if steps in arrivals:
-            rids.append(make(arrivals[steps]))
-        if steps == 5:
-            eng.cancel(cancel_rid)         # may already be terminal: both ok
-        for fin in eng.step():
-            assert fin.rid not in terminals, \
-                f"rid {fin.rid} reached two terminal states"
-            terminals[fin.rid] = fin
-    assert set(terminals) == set(rids)
+    rids, terminals = _drive_chaos_load(
+        eng, rng, arrivals={2: None, 4: 0.01, 6: None, 8: None, 10: 0.02})
     for fin in terminals.values():
         assert fin.finish_reason in TERMINAL_REASONS
         assert fin.reason == fin.finish_reason
@@ -174,6 +183,64 @@ def test_injected_growth_failure_stalls_without_cascade():
     out = eng.run()
     for rid, ref in zip(rids, refs):
         np.testing.assert_array_equal(out[rid].tokens, ref)
+
+
+def _chaos_observed_run(seed):
+    """One deterministic chaos run with metrics attached: same model
+    weights, same FaultPlan, same load script — everything downstream
+    must be bit-identical between two invocations."""
+    model = _model()
+    plan = FaultPlan.random(seed, n_steps=25, p_alloc=0.18, p_raise=0.10,
+                            p_latency=0.15, max_latency_s=0.02,
+                            step_tick_s=1e-3)
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=8,
+                        chunk_tokens=8, max_queue=3, faults=plan,
+                        metrics=True)
+    rng = np.random.RandomState(1000 + seed)
+    rids, terminals = _drive_chaos_load(
+        eng, rng, arrivals={2: None, 4: 0.015, 6: None, 9: None})
+    # key by arrival ORDER, not rid — the rid counter is process-global,
+    # so a replay mints different rids for the same scripted load
+    return eng, {i: terminals[r] for i, r in enumerate(rids)}
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 5])
+def test_chaos_registry_terminals_exact_and_deterministic(seed):
+    """r11 satellites: (1) the registry's terminal counters equal the
+    observed FinishedRequest terminals EXACTLY — per reason AND in
+    total — under a seeded FaultPlan; (2) the request-time histograms
+    (queue wait / TTFT / TBT / e2e), driven by the plan's virtual
+    clock, read out bit-identically across two replays of the seed."""
+    from collections import Counter
+
+    eng1, term1 = _chaos_observed_run(seed)
+    sc1 = eng1.metrics.scalars()
+    by_reason = Counter(f.finish_reason for f in term1.values())
+    for r in TERMINAL_REASONS:
+        assert sc1[f"serving_requests_terminal_{r}"] == by_reason.get(r, 0)
+    assert sum(sc1[f"serving_requests_terminal_{r}"]
+               for r in TERMINAL_REASONS) == len(term1)
+    assert sc1["serving_requests_enqueued"] == len(term1)
+    # counters mirrored from the stats ledger cannot diverge from it
+    assert sc1["serving_tokens_generated"] == eng1.stats["tokens_generated"]
+    assert sc1["serving_step_faults"] == eng1.stats["step_faults"]
+    assert sc1["serving_preemptions"] == eng1.stats["preemptions"]
+
+    # replay the seed: virtual-clock histograms must be bit-identical
+    eng2, term2 = _chaos_observed_run(seed)
+    sc2 = eng2.metrics.scalars()
+    assert {r: f.finish_reason for r, f in term1.items()} == \
+        {r: f.finish_reason for r, f in term2.items()}
+    for hist in ("serving_queue_wait_s", "serving_ttft_s", "serving_tbt_s",
+                 "serving_e2e_latency_s"):
+        keys = [k for k in sc1 if k.startswith(hist)]
+        assert keys, hist
+        for k in keys:
+            assert sc1[k] == sc2[k], f"{k} not deterministic"
+    # something actually landed in the engine-clock histograms
+    assert sc1["serving_ttft_s_count"] > 0
+    assert sc1["serving_e2e_latency_s_count"] == len(term1)
 
 
 def test_real_fault_mid_step_reparks_terminals(monkeypatch):

@@ -3,7 +3,6 @@
 
 import os
 import stat
-import struct
 
 import numpy as np
 import pytest
@@ -23,30 +22,30 @@ def test_crc32c_known_vector():
     assert _crc32c(b"123456789") == 0xE3069283
 
 
-def _read_events(path):
-    """Deframe TFRecords + parse Event protos with the repo's own proto
-    reader, verifying both CRCs."""
-    from paddle_tpu.onnx.proto import parse_message
-    from paddle_tpu.utils.tensorboard import _masked_crc
+def test_crc32c_rfc3720_vector_suite():
+    """The full RFC 3720 B.4 test-pattern set + edge cases — the framing
+    the r11 reader verifies record-by-record must agree with the
+    published Castagnoli vectors, not merely with our own writer."""
+    from paddle_tpu.utils.tensorboard import _crc32c, _masked_crc
 
-    out = []
-    raw = open(path, "rb").read()
-    pos = 0
-    while pos < len(raw):
-        (ln,) = struct.unpack_from("<Q", raw, pos)
-        (lcrc,) = struct.unpack_from("<I", raw, pos + 8)
-        assert lcrc == _masked_crc(raw[pos:pos + 8])
-        payload = raw[pos + 12:pos + 12 + ln]
-        (pcrc,) = struct.unpack_from("<I", raw, pos + 12 + ln)
-        assert pcrc == _masked_crc(payload)
-        pos += 12 + ln + 4
-        out.append(parse_message(payload))
-    return out
+    assert _crc32c(b"") == 0x00000000
+    assert _crc32c(b"a") == 0xC1D04330
+    assert _crc32c(b"\x00" * 32) == 0x8A9136AA          # RFC 3720: zeros
+    assert _crc32c(b"\xff" * 32) == 0x62A8AB43          # RFC 3720: ones
+    assert _crc32c(bytes(range(32))) == 0x46DD794E      # RFC 3720: incr.
+    assert _crc32c(bytes(range(31, -1, -1))) == 0x113FDB5C  # decrementing
+    # the TFRecord masking rotation is its own invertible transform
+    assert _masked_crc(b"123456789") == (
+        (((0xE3069283 >> 15) | (0xE3069283 << 17)) + 0xA282EAD8)
+        & 0xFFFFFFFF)
 
 
 def test_summary_writer_scalars_roundtrip(tmp_path):
-    from paddle_tpu.onnx.proto import parse_message
-    from paddle_tpu.utils.tensorboard import SummaryWriter
+    """Writer output read back through the production reader (r11 — the
+    old test-local deframer is gone; utils.tensorboard.read_events /
+    read_scalars ARE the CRC-verifying implementation under test)."""
+    from paddle_tpu.utils.tensorboard import (SummaryWriter, read_events,
+                                              read_scalars)
 
     with SummaryWriter(str(tmp_path)) as w:
         w.add_scalar("loss", 2.5, step=1)
@@ -56,19 +55,71 @@ def test_summary_writer_scalars_roundtrip(tmp_path):
     files = [f for f in os.listdir(tmp_path)
              if f.startswith("events.out.tfevents.")]
     assert len(files) == 1
-    events = _read_events(os.path.join(tmp_path, files[0]))
-    # first record: file_version "brain.Event:2" (field 3)
-    assert events[0][3][0] == b"brain.Event:2"
-    scalars = []
-    for ev in events[1:]:
-        step = ev.get(2, [0])[0]
-        summ = parse_message(ev[5][0])
-        val = parse_message(summ[1][0])
-        tag = val[1][0].decode()
-        scalars.append((tag, step, round(val[2][0], 6)))  # fixed32 -> float
-    assert ("loss", 1, 2.5) in scalars
-    assert ("loss", 2, 1.25) in scalars
-    assert ("acc", 2, 0.75) in scalars
+    path = os.path.join(tmp_path, files[0])
+    events = read_events(path)
+    assert events[0]["file_version"] == "brain.Event:2"
+    scalars = {(tag, step): round(v, 6)
+               for tag, pts in read_scalars(path).items()
+               for step, v in pts}
+    assert scalars[("loss", 1)] == 2.5
+    assert scalars[("loss", 2)] == 1.25
+    assert scalars[("acc", 2)] == 0.75
+
+
+def test_reader_roundtrip_scalars(tmp_path):
+    """r11 satellite: the writer's own framing read back through the new
+    reader — tags, steps and values survive the trip, the file_version
+    header parses, and both CRCs verify on every record."""
+    from paddle_tpu.utils.tensorboard import (SummaryWriter, read_events,
+                                              read_scalars)
+
+    with SummaryWriter(str(tmp_path)) as w:
+        for step in range(1, 6):
+            w.add_scalar("loss", 1.0 / step, step=step)
+            w.add_scalar("acc", step / 10.0, step=step)
+        w.add_scalar("lr", 3e-4, step=3)
+    fname = [f for f in os.listdir(tmp_path)
+             if f.startswith("events.out.tfevents.")][0]
+    path = os.path.join(tmp_path, fname)
+
+    events = read_events(path)
+    assert events[0]["file_version"] == "brain.Event:2"
+    assert len(events) == 12               # header + 11 scalars
+    assert all(ev["wall_time"] > 0 for ev in events)
+
+    series = read_scalars(path)
+    assert set(series) == {"loss", "acc", "lr"}
+    assert [s for s, _ in series["loss"]] == [1, 2, 3, 4, 5]
+    for step, v in series["loss"]:
+        assert v == pytest.approx(1.0 / step, rel=1e-6)
+    assert series["lr"] == [(3, pytest.approx(3e-4, rel=1e-6))]
+    # dir-level read aggregates the same content
+    assert read_scalars(str(tmp_path)) == series
+
+
+def test_reader_rejects_corruption(tmp_path):
+    """A flipped payload byte or a truncated tail must fail LOUDLY (CRC /
+    framing error), never silently yield wrong scalars."""
+    from paddle_tpu.utils.tensorboard import SummaryWriter, read_events
+
+    with SummaryWriter(str(tmp_path)) as w:
+        w.add_scalar("x", 1.5, step=1)
+    fname = [f for f in os.listdir(tmp_path)
+             if f.startswith("events.out.tfevents.")][0]
+    path = os.path.join(tmp_path, fname)
+    raw = bytearray(open(path, "rb").read())
+
+    flipped = bytearray(raw)
+    flipped[-6] ^= 0xFF                    # inside the last payload
+    bad = os.path.join(tmp_path, "bad")
+    open(bad, "wb").write(bytes(flipped))
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        read_events(bad)
+
+    trunc = os.path.join(tmp_path, "trunc")
+    open(trunc, "wb").write(bytes(raw[:-3]))
+    with pytest.raises(ValueError, match="truncated|CRC"):
+        read_events(trunc)
 
 
 def test_visualdl_callback_writes_event_file(tmp_path):
@@ -104,7 +155,9 @@ def test_visualdl_callback_writes_event_file(tmp_path):
     files = [f for f in os.listdir(train_dir)
              if f.startswith("events.out.tfevents.")]
     assert files, os.listdir(tmp_path)
-    events = _read_events(os.path.join(train_dir, files[0]))
+    from paddle_tpu.utils.tensorboard import read_events
+
+    events = read_events(os.path.join(train_dir, files[0]))
     assert len(events) > 2  # file version + per-batch scalars
 
 
