@@ -181,6 +181,14 @@ def main():
             hidden=1536, layers=24, heads=12, vocab=50304, n_requests=48,
             max_slots=8, page_size=64, prompt_len=96, new_tokens=96,
             dtype="bfloat16", overload_factor=3.0, decode_block=8)
+        # multi-tenant SLO isolation: 3 weighted tenants at 3x capacity,
+        # FCFS vs WFQ (ISSUE r12 acceptance: WFQ shares within +/-10
+        # points of weights, aggregate >= 0.95x FCFS)
+        serving_slo = _slo_serving_bench(
+            hidden=1536, layers=24, heads=12, vocab=50304, n_per_tenant=16,
+            weights=(3.0, 2.0, 1.0), max_slots=8, page_size=64,
+            prompt_len=96, new_tokens=96, dtype="bfloat16",
+            overload_factor=3.0, decode_block=8)
         resnet = _resnet50_bench()
         bert = _bert_bench()
         head = flagship
@@ -217,6 +225,11 @@ def main():
             hidden=64, layers=2, heads=2, vocab=256, n_requests=6,
             max_slots=2, page_size=8, prompt_len=8, new_tokens=12,
             dtype="float32", overload_factor=3.0, decode_block=2)
+        serving_slo = _slo_serving_bench(
+            hidden=64, layers=2, heads=2, vocab=256, n_per_tenant=3,
+            weights=(3.0, 2.0, 1.0), max_slots=2, page_size=8,
+            prompt_len=8, new_tokens=12, dtype="float32",
+            overload_factor=3.0, decode_block=2)
         small = None
 
     out = {
@@ -239,6 +252,7 @@ def main():
     out["extra"]["serving"] = serving
     out["extra"]["serving_prefix"] = serving_prefix
     out["extra"]["serving_overload"] = serving_overload
+    out["extra"]["serving_slo"] = serving_slo
     # r11 acceptance guard: feeding the metrics registry + tracer every
     # step must not move engine goodput (CPU-sized on purpose — python
     # host-loop overhead is what it measures)
@@ -737,6 +751,162 @@ def _overload_serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
                    "prompt_len": prompt_len, "new_tokens": new_tokens,
                    "dtype": dtype, "overload_factor": overload_factor,
                    "max_queue": max_queue,
+                   "deadline_s": round(deadline_s, 4),
+                   "decode_block": decode_block},
+    }
+
+
+def _slo_serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
+                       n_per_tenant=16, weights=(3.0, 2.0, 1.0),
+                       max_slots=8, page_size=64, prompt_len=96,
+                       new_tokens=96, dtype="bfloat16",
+                       overload_factor=3.0, deadline_factor=8.0,
+                       decode_block=8, seed=0):
+    """Multi-tenant SLO isolation under overload: FCFS vs WFQ (r12).
+
+    Three tenants (weights ``weights``, equal demand of ``n_per_tenant``
+    requests each) arrive Poisson at ``overload_factor`` x the measured
+    at-capacity completion rate, every request carrying a deadline of
+    ``deadline_factor`` x the at-capacity mean latency — so only timely
+    work completes and the scheduler's admission ORDER decides who makes
+    their SLO.  The same arrival trace runs through two engines:
+
+      * **fcfs**: the r08 default — arrival order, tenant-blind.  Under
+        overload every tenant degrades equally (shares ~ demand).
+      * **wfq**: weighted fair queueing over per-tenant virtual token
+        counters — completed-token shares should track the weight ratio.
+
+    Reported per tenant and per leg: goodput tokens/s of COMPLETED
+    requests, share of completed tokens, p99 TTFT (arrival -> first
+    token, measured through the engine's on_token streaming hook — the
+    same observable the HTTP front end streams), completion/expiry
+    counts.  The acceptance bar (tests/test_bench_extras.py, slow): WFQ
+    per-tenant shares within +/-10 points of the configured weight
+    shares while aggregate goodput stays >= 0.95x FCFS — fairness must
+    reallocate capacity, not burn it.
+    """
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_seq_len=prompt_len + new_tokens,
+                    dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    if dtype == "bfloat16":
+        for p in model.parameters():
+            p._array = p._array.astype(jnp.bfloat16)
+
+    tenant_names = [chr(ord("a") + i) for i in range(len(weights))]
+    tenant_weights = dict(zip(tenant_names, [float(w) for w in weights]))
+    n_requests = n_per_tenant * len(tenant_names)
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(0, vocab, (n_requests, prompt_len)).astype("int32")
+    tenant_of = [tenant_names[j % len(tenant_names)]
+                 for j in range(n_requests)]
+
+    def build(policy, tenants=None):
+        eng = ServingEngine(model, max_slots=max_slots, page_size=page_size,
+                            greedy=True, decode_block=decode_block,
+                            prefix_cache=False, policy=policy,
+                            tenants=tenants)
+        eng.add_request(prompts[0], 2)    # compile prefill + decode
+        eng.run()
+        for k in ("prefill_calls", "decode_calls", "tokens_generated",
+                  "rejected", "expired", "cancelled", "preemptions"):
+            eng.stats[k] = 0
+        return eng
+
+    def drive(eng, arrivals, deadline_s):
+        order = np.argsort(arrivals, kind="stable")
+        pending = [(float(arrivals[j]), j) for j in order]
+        rid2idx, fins, first_tok = {}, {}, {}
+        eng.attach_metrics()
+        _reset_mirrored_stats(eng)
+        t0 = time.perf_counter()
+        # TTFT through the same hook the HTTP front end streams on
+        eng.on_token = lambda rid, tok: first_tok.setdefault(
+            rid, time.perf_counter() - t0)
+        makespan = 1e-9
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, j = pending.pop(0)
+                rid = eng.add_request(prompts[j], new_tokens,
+                                      deadline_s=deadline_s,
+                                      tenant=tenant_of[j])
+                rid2idx[rid] = j
+            if not eng.has_work:
+                if pending:
+                    time.sleep(min(pending[0][0] - now, 0.01))
+                continue
+            for fin in eng.step():
+                done = time.perf_counter() - t0
+                fins[rid2idx[fin.rid]] = (fin, done)
+                makespan = done
+        eng.on_token = None
+        total_good = sum(int(fin.tokens.size)
+                         for fin, _ in fins.values() if fin.ok)
+        per_tenant = {}
+        for t in tenant_names:
+            idxs = [j for j in range(n_requests) if tenant_of[j] == t]
+            t_fins = [(j, fins[j][0]) for j in idxs if j in fins]
+            good_tokens = sum(int(f.tokens.size) for _, f in t_fins if f.ok)
+            ttfts = [first_tok[f.rid] - arrivals[j]
+                     for j, f in t_fins if f.rid in first_tok]
+            per_tenant[t] = {
+                "weight": tenant_weights.get(t, 1.0),
+                "goodput_tokens_per_sec": round(good_tokens / makespan, 1),
+                "share_of_completed_tokens": round(
+                    good_tokens / max(total_good, 1), 4),
+                "completed": sum(1 for _, f in t_fins if f.ok),
+                "expired": sum(1 for _, f in t_fins
+                               if f.finish_reason == "expired"),
+                "p99_ttft_s": (round(float(np.percentile(ttfts, 99)), 4)
+                               if ttfts else None),
+            }
+        return {
+            "goodput_tokens_per_sec": round(total_good / makespan, 1),
+            "makespan_s": round(makespan, 3),
+            "completed": sum(1 for fin, _ in fins.values() if fin.ok),
+            "per_tenant": per_tenant,
+            "metrics": _registry_dict(eng.metrics),
+        }
+
+    # -- phase 1: at-capacity calibration (burst, no deadlines) ----------
+    eng_cal = build("fcfs")
+    at_cap = drive(eng_cal, np.zeros(n_requests), None)
+    mean_lat = max(at_cap["makespan_s"] / max(n_requests, 1), 1e-3)
+    deadline_s = deadline_factor * mean_lat
+    rate = overload_factor * n_requests / at_cap["makespan_s"]
+
+    # -- phase 2: the SAME overload trace, FCFS vs WFQ -------------------
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    fcfs = drive(eng_cal, arrivals, deadline_s)   # drained: reusable
+    wfq = drive(build("wfq", tenants=tenant_weights), arrivals, deadline_s)
+    weight_total = sum(tenant_weights.values())
+    return {
+        "at_capacity": at_cap,
+        "fcfs": fcfs,
+        "wfq": wfq,
+        "weight_shares": {t: round(w / weight_total, 4)
+                          for t, w in tenant_weights.items()},
+        "max_share_error_wfq": round(max(
+            abs(wfq["per_tenant"][t]["share_of_completed_tokens"]
+                - tenant_weights[t] / weight_total)
+            for t in tenant_names), 4),
+        "aggregate_ratio_wfq_vs_fcfs": round(
+            wfq["goodput_tokens_per_sec"]
+            / max(fcfs["goodput_tokens_per_sec"], 1e-9), 3),
+        "config": {"hidden": hidden, "layers": layers, "heads": heads,
+                   "vocab": vocab, "n_per_tenant": n_per_tenant,
+                   "n_requests": n_requests, "weights": list(weights),
+                   "max_slots": max_slots, "page_size": page_size,
+                   "prompt_len": prompt_len, "new_tokens": new_tokens,
+                   "dtype": dtype, "overload_factor": overload_factor,
                    "deadline_s": round(deadline_s, 4),
                    "decode_block": decode_block},
     }

@@ -27,6 +27,11 @@ CPU-runnable out of the box (tiny config); flags scale it up::
     python examples/serve_gpt.py --inject-faults 7   # deterministic chaos
     python examples/serve_gpt.py --metrics-dir /tmp/serve_metrics
         # + TensorBoard scalars, metrics.prom, Perfetto trace.json (r11)
+    python examples/serve_gpt.py --http 8000 --tenants a:3,b:1
+        # r12: streaming HTTP front end (SSE /v1/completions, /metrics,
+        # /healthz) with weighted-fair multi-tenant scheduling:
+        #   curl -N localhost:8000/v1/completions \
+        #        -d '{"prompt": [1,2,3], "max_tokens": 8, "tenant": "a"}'
 """
 
 import argparse
@@ -77,6 +82,16 @@ def main():
                          "(tensorboard --logdir DIR), a Prometheus "
                          "metrics.prom text dump, and a Chrome trace.json "
                          "(open at https://ui.perfetto.dev) land in DIR")
+    ap.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="serve the streaming HTTP front end instead of "
+                         "the scripted demo load: SSE /v1/completions "
+                         "over token ids, /metrics Prometheus scrape, "
+                         "/healthz (r12)")
+    ap.add_argument("--tenants", default=None, metavar="SPEC",
+                    help="comma-separated name:weight pairs (e.g. "
+                         "'a:3,b:1') enabling weighted-fair multi-tenant "
+                         "scheduling; requests pick their tenant via the "
+                         "HTTP body's \"tenant\" field")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -92,6 +107,12 @@ def main():
 
     faults = (FaultPlan.random(args.inject_faults, n_steps=50)
               if args.inject_faults is not None else None)
+    tenants = None
+    if args.tenants:
+        tenants = {}
+        for part in args.tenants.split(","):
+            name, _, weight = part.partition(":")
+            tenants[name.strip()] = float(weight) if weight else 1.0
     eng = ServingEngine(model, max_slots=args.slots,
                         page_size=args.page_size,
                         decode_block=args.decode_block,
@@ -100,8 +121,34 @@ def main():
                         greedy=args.top_p >= 1.0, top_p=args.top_p,
                         eos_token_id=args.eos, int8=args.int8,
                         max_queue=args.max_queue, faults=faults,
+                        tenants=tenants,
                         metrics=args.metrics_dir is not None,
                         trace=args.metrics_dir is not None)
+    if args.http is not None:
+        from paddle_tpu.serving.frontend import serve
+
+        # compile both programs before accepting traffic, then hand the
+        # host loop to the asyncio driver until Ctrl-C
+        eng.add_request(np.arange(4, dtype=np.int32), 2)
+        eng.run()
+        print(f"engine warm: slots={args.slots} policy="
+              f"{eng.scheduler.policy.name} tenants={tenants or '-'}")
+        try:
+            serve(eng, port=args.http)
+        finally:
+            if args.metrics_dir is not None:
+                # the demo-load exporter path below never runs in HTTP
+                # mode — dump the artifacts the flag promised at exit
+                from paddle_tpu.serving import MetricsFileExporter
+
+                os.makedirs(args.metrics_dir, exist_ok=True)
+                with MetricsFileExporter(eng.metrics,
+                                         args.metrics_dir) as ex:
+                    ex.flush(eng._step_idx)
+                trace = eng.tracer.save(
+                    os.path.join(args.metrics_dir, "trace.json"))
+                print(f"metrics -> {ex.prom_path}, trace -> {trace}")
+        return
     exporter = None
     if args.metrics_dir is not None:
         from paddle_tpu.serving import MetricsFileExporter, attach_profiler

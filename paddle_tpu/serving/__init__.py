@@ -27,6 +27,16 @@ Composes four pieces:
     TensorBoard + Prometheus file exporters
     (``ServingEngine(metrics=..., trace=...)``,
     ``engine.run(metrics_dir=...)``);
+  * multi-tenant serving front end (r12): pluggable
+    :class:`~paddle_tpu.serving.tenancy.SchedulerPolicy` over the
+    waiting queue — FCFS default, Virtual-Token-Counter weighted fair
+    queueing (:class:`~paddle_tpu.serving.tenancy.WFQPolicy`) with
+    per-tenant weights/priorities/quotas — and a stdlib-asyncio
+    streaming HTTP API
+    (:class:`~paddle_tpu.serving.frontend.ServingFrontend`: SSE
+    ``/v1/completions`` per engine step via ``on_token``, ``/metrics``
+    Prometheus scrape, ``/healthz``, disconnect→cancel, 429/408 SLO
+    mapping);
   * fault tolerance (r10): on-demand page growth with
     preempt-and-recompute under pool pressure, per-request deadlines /
     ``cancel`` / bounded-queue backpressure,
@@ -42,6 +52,8 @@ See README "Serving" for the architecture and knobs;
 from .kv_pool import KVPool
 from .prefix_cache import PrefixIndex
 from .scheduler import Admission, FCFSScheduler, Request
+from .tenancy import (DEFAULT_TENANT, FCFSPolicy, SchedulerPolicy,
+                      TenantConfig, WFQPolicy)
 from .metrics import (Counter, Gauge, Histogram, MetricsFileExporter,
                       MetricsRegistry)
 from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, TraceRecorder,
@@ -49,6 +61,7 @@ from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, TraceRecorder,
 from .engine import TERMINAL_REASONS, FinishedRequest, ServingEngine
 from .faults import FaultPlan, InjectedFault
 from .snapshot import restore_engine, snapshot_engine
+from .frontend import ServingFrontend
 
 __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "ServingEngine", "FinishedRequest", "TERMINAL_REASONS",
@@ -56,4 +69,6 @@ __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "restore_engine", "MetricsRegistry", "Counter", "Gauge",
            "Histogram", "MetricsFileExporter", "TraceRecorder",
            "attach_profiler", "detach_profiler", "PID_ENGINE",
-           "PID_REQUESTS", "PID_HOST"]
+           "PID_REQUESTS", "PID_HOST",
+           "SchedulerPolicy", "FCFSPolicy", "WFQPolicy", "TenantConfig",
+           "DEFAULT_TENANT", "ServingFrontend"]

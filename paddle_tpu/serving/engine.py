@@ -100,6 +100,7 @@ from .faults import FaultPlan, InjectedFault
 from .kv_pool import KVPool
 from .metrics import MetricsRegistry
 from .scheduler import FCFSScheduler, Request
+from .tenancy import normalize_tenants
 from .tracing import PID_ENGINE, PID_REQUESTS, TraceRecorder
 
 #: Reasons a request leaves the engine.  "eos"/"length" are successful
@@ -193,6 +194,19 @@ class ServingEngine:
     observations (queue wait, TTFT, time-between-tokens, e2e latency)
     are measured on the ENGINE clock, so a FaultPlan's virtual clock
     makes their histograms bit-deterministic.
+
+    r12 multi-tenancy/streaming knobs: ``policy`` picks the waiting-
+    queue order (``"fcfs"`` default, ``"wfq"`` for weighted fair
+    queueing over per-tenant virtual token counters, or a
+    :class:`~paddle_tpu.serving.tenancy.SchedulerPolicy` instance);
+    ``tenants`` maps tenant name -> weight /
+    :class:`~paddle_tpu.serving.tenancy.TenantConfig` (naming tenants
+    implies WFQ); ``on_token(rid, token)`` observes every sampled token
+    in delivery order — the streaming HTTP front end
+    (:class:`~paddle_tpu.serving.frontend.ServingFrontend`) builds SSE
+    on it.  Requests carry ``tenant=`` through :meth:`add_request`;
+    per-tenant token/terminal counters land in the metrics registry as
+    labeled series (``serving_tenant_*{tenant="..."}``).
     """
 
     def __init__(self, model, *, max_slots: int = 8, page_size: int = 32,
@@ -209,7 +223,9 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  faults: Optional[FaultPlan] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 metrics=None, trace=None):
+                 metrics=None, trace=None,
+                 policy=None, tenants=None,
+                 on_token: Optional[Callable[[int, int], None]] = None):
         cfg = model.cfg
         self.cfg = cfg
         # decode_block > 1 fuses that many decode steps into ONE dispatched
@@ -246,7 +262,16 @@ class ServingEngine:
                            prefix_cache=prefix_cache)
         self.pool.faults = faults
         self.scheduler = FCFSScheduler(max_slots, self.pool,
-                                       token_budget=token_budget)
+                                       token_budget=token_budget,
+                                       policy=policy, tenants=tenants)
+        # per-token observer (r12): called as on_token(rid, token) once
+        # for every token the engine samples for a live request —
+        # prefill-completion samples and decode tokens alike, in exactly
+        # the order they land on FinishedRequest.tokens.  The streaming
+        # front end (serving/frontend.py) hangs SSE delivery off this.
+        # Settable after construction; like faults/clock it is NOT part
+        # of a snapshot.
+        self.on_token = on_token
         self._sample = _make_sampler(greedy, temperature, top_k, top_p)
         if use_paged_kernel is None:
             self._use_kernel = pa.available() and pa.supported(
@@ -268,7 +293,15 @@ class ServingEngine:
             eos_token_id=eos_token_id, int8=self.int8, seed=seed,
             decode_block=decode_block, use_paged_kernel=use_paged_kernel,
             chunk_tokens=chunk_tokens, prefix_cache=prefix_cache,
-            max_queue=max_queue)
+            max_queue=max_queue,
+            # the POLICY NAME, not the instance: a restored engine
+            # rebuilds the named policy and reloads its counters from
+            # the snapshot's scheduler state (a custom SchedulerPolicy
+            # instance is like faults/clock — not snapshot-portable)
+            policy=self.scheduler.policy.name,
+            tenants=({t: dataclasses.asdict(c)
+                      for t, c in normalize_tenants(tenants).items()}
+                     if tenants else None))
 
         # host mirrors of the decode step's device operands
         self._tokens_this_step = 0
@@ -462,15 +495,18 @@ class ServingEngine:
 
     def add_request(self, prompt, max_new_tokens: int,
                     arrival: float = 0.0,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    tenant: Optional[str] = None) -> int:
         """Queue one request; returns its rid.  The prompt + continuation
         must fit ``max_seq_len`` (the slot's block-table width).
         ``deadline_s`` expires the request that many engine-clock seconds
-        after enqueue, whatever state it is in."""
+        after enqueue, whatever state it is in.  ``tenant`` names the
+        account the request schedules and bills under (WFQ policy;
+        ignored by FCFS beyond metric labels)."""
         return self._enqueue(
             Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
                     max_new_tokens=max_new_tokens, arrival=arrival,
-                    deadline_s=deadline_s))
+                    deadline_s=deadline_s, tenant=tenant))
 
     def _enqueue(self, req: Request) -> int:
         """Single admission gate for both add_request and run(): every
@@ -485,8 +521,11 @@ class ServingEngine:
         req.t_enqueue = self._now()
         if self.metrics is not None:
             self._m["enqueued"].inc()
-        if (self.max_queue is not None
-                and self.scheduler.n_waiting >= self.max_queue):
+        if ((self.max_queue is not None
+             and self.scheduler.n_waiting >= self.max_queue)
+                or self.scheduler.quota_reject(req.tenant)):
+            # global queue bound OR the tenant's own max_waiting quota:
+            # both are backpressure, both become an explicit terminal
             if self.tracer is not None:
                 self.tracer.begin("queued", PID_REQUESTS, req.rid)
             self.stats["rejected"] += 1
@@ -544,6 +583,7 @@ class ServingEngine:
         overwrite them, not add — aggregate replicas by summing their
         registries' ``scalars()`` instead."""
         self.metrics = registry if registry is not None else MetricsRegistry()
+        self._tenant_metrics = {}   # (family, tenant[, reason]) -> metric
         c = self.metrics.counter
         g = self.metrics.gauge
         h = self.metrics.histogram
@@ -623,6 +663,42 @@ class ServingEngine:
         if self.tracer.open_span(PID_REQUESTS, rid) is not None:
             self.tracer.end(PID_REQUESTS, rid, args)
 
+    def _tenant_counter(self, family: str, help: str, tenant: str,
+                        reason: Optional[str] = None):
+        """Lazily-created per-tenant labeled counter (r12).  Tenants are
+        an open set (requests name them), so these cannot be
+        pre-registered in attach_metrics like the label-free families."""
+        key = (family, tenant, reason)
+        m = self._tenant_metrics.get(key)
+        if m is None:
+            labels = {"tenant": tenant}
+            if reason is not None:
+                labels["reason"] = reason
+            m = self.metrics.counter(family, help, labels=labels)
+            self._tenant_metrics[key] = m
+        return m
+
+    def _emit_token(self, req: Request, tok: int) -> None:
+        """One sampled token just landed on ``req`` (the caller already
+        appended it) — feed the streaming observer and the per-tenant
+        token counter.  Called in delivery order, so an on_token stream
+        is token-for-token the eventual FinishedRequest.tokens."""
+        if self.on_token is not None:
+            self.on_token(req.rid, tok)
+        if self.metrics is not None and req.tenant is not None:
+            self._tenant_counter("serving_tenant_tokens_generated",
+                                 "sampled tokens per tenant",
+                                 req.tenant).inc()
+
+    def _charge_service(self, req: Request) -> None:
+        """Bill the request's first-time-served token delta to its
+        tenant's virtual counter (WFQ; no-op under FCFS).  Safe to call
+        at every service point — the delta is 0 when nothing new was
+        served (including the whole recompute of a preempted request)."""
+        delta = req.uncharged_tokens()
+        if delta > 0:
+            self.scheduler.charge(req, delta)
+
     def _observe_terminal(self, req: Request, reason: str) -> None:
         """Single funnel for EVERY FinishedRequest creation: terminal
         counters here are exactly one inc per terminal, which is what
@@ -630,6 +706,10 @@ class ServingEngine:
         if self.metrics is not None:
             self._m["terminal"][reason].inc()
             self._m["e2e"].observe(self._now() - req.t_enqueue)
+            if req.tenant is not None:
+                self._tenant_counter("serving_tenant_requests_terminal",
+                                     "per-tenant terminals by reason",
+                                     req.tenant, reason).inc()
         if self.tracer is not None:
             self._tr_end(req.rid)
             self.tracer.instant(reason, PID_REQUESTS, req.rid,
@@ -682,7 +762,7 @@ class ServingEngine:
         self._table[idx] = 0
         self._tok[idx] = 0
         self._len[idx] = 0
-        self.scheduler.release(idx, st.pages)
+        self.scheduler.release(idx, st.pages, st.request)
         self._observe_terminal(st.request, reason)
         return FinishedRequest(
             rid=st.request.rid, prompt=st.request.prompt,
@@ -700,7 +780,7 @@ class ServingEngine:
         self._table[idx] = 0
         self._tok[idx] = 0
         self._len[idx] = 0
-        self.scheduler.release(idx, st.pages)
+        self.scheduler.release(idx, st.pages, st.request)
         st.request.n_preempted += 1
         self.scheduler.requeue(st.request)
         self.stats["preemptions"] += 1
@@ -824,6 +904,10 @@ class ServingEngine:
                 st.prefilled += n
                 budget -= n
                 self._tokens_this_step += n
+                # WFQ accounting: bill first-time prompt positions (a
+                # recomputed chunk below the high-water mark bills 0)
+                req.note_prefill_progress(st.prefilled)
+                self._charge_service(req)
                 if st.prefilled < st.base_len:
                     continue
                 # prompt complete: next token sampled; its full pages
@@ -834,6 +918,8 @@ class ServingEngine:
                     self.pool.prefix.insert(work, st.pages[:nfull])
                 tok = int(tok)
                 st.tokens.append(tok)
+                self._emit_token(req, tok)
+                self._charge_service(req)
                 self.stats["tokens_generated"] += 1
                 now = self._now()
                 if req.t_first_token is None:
@@ -1033,9 +1119,11 @@ class ServingEngine:
                 consumed = int(min(self.decode_block, remaining[idx]))
                 reason = None
                 n_new = 0
+                req = st.request
                 for i in range(consumed):
                     tok = int(toks_all[i, idx])
                     st.tokens.append(tok)
+                    self._emit_token(req, tok)
                     n_new += 1
                     self.stats["tokens_generated"] += 1
                     if (self.eos_token_id is not None
@@ -1043,7 +1131,7 @@ class ServingEngine:
                         reason = "eos"
                         break
                 self._tokens_this_step += n_new
-                req = st.request
+                self._charge_service(req)
                 if (self.metrics is not None and n_new
                         and req.t_last_token is not None):
                     self._m["tbt"].observe((now - req.t_last_token) / n_new)
@@ -1089,6 +1177,10 @@ class ServingEngine:
                 raise AssertionError(
                     f"slot {i} occupancy disagrees with the scheduler's "
                     "free-slot list")
+        # policy-side accounting (r12): per-tenant residency counts must
+        # match the slots, virtual counters must stay finite/non-negative
+        self.scheduler.policy.check(
+            [s.request for s in self._slots if s is not None])
 
     def run(self, requests: Optional[Sequence] = None,
             metrics_dir: Optional[str] = None, flush_every: int = 1

@@ -18,12 +18,17 @@ contract — ``tests/test_metrics.py`` guards it):
     for latency: fixed memory, O(1) observe, quantiles by linear
     interpolation within the straddling bucket.  Exact min/max/sum ride
     along so readouts stay honest at small counts;
-  * :class:`MetricsRegistry` — the namespace: get-or-create by name,
-    ``scalars()`` flattens everything (histograms expand to
+  * :class:`MetricsRegistry` — the namespace: get-or-create by name (+
+    optional ``labels=`` dict, r12: one instance per (name, labels)
+    combination, rendered ``name{tenant="a"}`` in Prometheus and
+    flattened ``name.tenant=a`` for TB scalars — the multi-tenant front
+    end's per-tenant series), ``scalars()`` flattens everything
+    (histograms expand to
     ``_count/_sum/_mean/_min/_max/_p50/_p90/_p99``) for the TensorBoard
     exporter, ``to_prometheus()`` emits the text exposition format
-    (cumulative ``_bucket{le=...}`` lines), ``to_state()`` /
-    ``from_state()`` make metrics survive engine snapshot/restore.
+    (cumulative ``_bucket{le=...}`` lines, one HELP/TYPE per family),
+    ``to_state()`` / ``from_state()`` make metrics survive engine
+    snapshot/restore.
 
 Exporters (both file-based, both dependency-free):
 
@@ -58,18 +63,55 @@ def _sanitize(name: str) -> str:
     return out if out and not out[0].isdigit() else "_" + out
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _canon_labels(labels) -> Tuple[Tuple[str, str], ...]:
+    """Sorted (key, value) pairs — the canonical identity of a labeled
+    series, so ``{"a": 1, "b": 2}`` and ``{"b": 2, "a": 1}`` name the
+    same metric."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _prom_labels(labels, extra: str = "") -> str:
+    """``{k="v",...}`` rendering (empty string for no labels); ``extra``
+    appends a pre-rendered pair (the histogram ``le`` bound)."""
+    parts = [f'{_sanitize(k)}="{_escape_label(v)}"'
+             for k, v in _canon_labels(labels)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _scalar_suffix(labels) -> str:
+    """Flat-tag rendering for TensorBoard scalars: ``name.tenant=a``."""
+    return "".join(f".{k}={v}" for k, v in _canon_labels(labels))
+
+
+def _series_key(name: str, labels) -> str:
+    """Registry key: base name + canonical label rendering, so each
+    (name, labels) combination is its own series."""
+    return name + _prom_labels(labels)
+
+
 class Counter:
     """Monotonic counter.  ``set_total`` exists ONLY for mirror-sync and
     snapshot-restore (the engine keeps some counters in lockstep with its
     ``stats`` ledger); user code should ``inc``."""
 
-    __slots__ = ("name", "help", "value")
+    __slots__ = ("name", "help", "labels", "value")
 
     kind = "counter"
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", labels=None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self.value = 0.0
 
     def inc(self, n: float = 1.0) -> None:
@@ -79,10 +121,11 @@ class Counter:
         self.value = float(v)
 
     def scalars(self) -> Dict[str, float]:
-        return {self.name: self.value}
+        return {self.name + _scalar_suffix(self.labels): self.value}
 
     def to_state(self) -> dict:
-        return {"kind": self.kind, "help": self.help, "value": self.value}
+        return {"kind": self.kind, "help": self.help, "name": self.name,
+                "labels": dict(self.labels), "value": self.value}
 
     def load_state(self, st: dict) -> None:
         self.value = float(st["value"])
@@ -91,13 +134,14 @@ class Counter:
 class Gauge:
     """Point-in-time level; ``set`` replaces, ``inc``/``dec`` adjust."""
 
-    __slots__ = ("name", "help", "value")
+    __slots__ = ("name", "help", "labels", "value")
 
     kind = "gauge"
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", labels=None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self.value = 0.0
 
     def set(self, v: float) -> None:
@@ -110,10 +154,11 @@ class Gauge:
         self.value -= n
 
     def scalars(self) -> Dict[str, float]:
-        return {self.name: self.value}
+        return {self.name + _scalar_suffix(self.labels): self.value}
 
     def to_state(self) -> dict:
-        return {"kind": self.kind, "help": self.help, "value": self.value}
+        return {"kind": self.kind, "help": self.help, "name": self.name,
+                "labels": dict(self.labels), "value": self.value}
 
     def load_state(self, st: dict) -> None:
         self.value = float(st["value"])
@@ -132,17 +177,18 @@ class Histogram:
     measured.
     """
 
-    __slots__ = ("name", "help", "bounds", "counts", "count", "sum",
-                 "min", "max")
+    __slots__ = ("name", "help", "labels", "bounds", "counts", "count",
+                 "sum", "min", "max")
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "", start: float = 1e-4,
-                 factor: float = 2.0, n_buckets: int = 24):
+                 factor: float = 2.0, n_buckets: int = 24, labels=None):
         if start <= 0 or factor <= 1.0 or n_buckets < 1:
             raise ValueError("need start > 0, factor > 1, n_buckets >= 1")
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
         self.bounds: List[float] = [start * factor ** i
                                     for i in range(n_buckets)]
         self.counts: List[int] = [0] * (n_buckets + 1)  # last = +Inf
@@ -182,17 +228,19 @@ class Histogram:
         return self.sum / self.count if self.count else 0.0
 
     def scalars(self) -> Dict[str, float]:
-        n = self.name
-        return {f"{n}_count": float(self.count), f"{n}_sum": self.sum,
-                f"{n}_mean": self.mean,
-                f"{n}_min": self.min if self.min is not None else 0.0,
-                f"{n}_max": self.max if self.max is not None else 0.0,
-                f"{n}_p50": self.quantile(0.50),
-                f"{n}_p90": self.quantile(0.90),
-                f"{n}_p99": self.quantile(0.99)}
+        n, sfx = self.name, _scalar_suffix(self.labels)
+        return {f"{n}_count{sfx}": float(self.count),
+                f"{n}_sum{sfx}": self.sum,
+                f"{n}_mean{sfx}": self.mean,
+                f"{n}_min{sfx}": self.min if self.min is not None else 0.0,
+                f"{n}_max{sfx}": self.max if self.max is not None else 0.0,
+                f"{n}_p50{sfx}": self.quantile(0.50),
+                f"{n}_p90{sfx}": self.quantile(0.90),
+                f"{n}_p99{sfx}": self.quantile(0.99)}
 
     def to_state(self) -> dict:
-        return {"kind": self.kind, "help": self.help,
+        return {"kind": self.kind, "help": self.help, "name": self.name,
+                "labels": dict(self.labels),
                 "bounds": list(self.bounds), "counts": list(self.counts),
                 "count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max}
@@ -225,6 +273,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, object] = {}
+        self._family_kind: Dict[str, str] = {}
 
     def __len__(self) -> int:
         return len(self._metrics)
@@ -232,29 +281,43 @@ class MetricsRegistry:
     def __contains__(self, name: str) -> bool:
         return name in self._metrics
 
-    def get(self, name: str):
-        return self._metrics.get(name)
+    def get(self, name: str, labels=None):
+        return self._metrics.get(_series_key(name, labels))
 
-    def _get_or_create(self, cls, name, help, **kw):
-        m = self._metrics.get(name)
+    def _get_or_create(self, cls, name, help, labels=None, **kw):
+        key = _series_key(name, labels)
+        m = self._metrics.get(key)
         if m is None:
-            m = cls(name, help=help, **kw)
-            self._metrics[name] = m
+            # every labeled series of one FAMILY (base name) must share a
+            # kind — Prometheus exposition emits one TYPE per family
+            known = self._family_kind.get(name)
+            if known is not None and known != cls.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {known}")
+            m = cls(name, help=help, labels=labels, **kw)
+            self._metrics[key] = m
+            self._family_kind[name] = cls.kind
         elif not isinstance(m, cls):
             raise ValueError(
                 f"metric {name!r} already registered as {m.kind}")
         return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "", labels=None) -> Counter:
+        """``labels={"tenant": "a"}`` makes this a labeled series:
+        rendered ``name{tenant="a"}`` in the Prometheus exposition and
+        flattened ``name.tenant=a`` in :meth:`scalars` — one instance
+        per distinct (name, labels) combination."""
+        return self._get_or_create(Counter, name, help, labels)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "", labels=None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
 
     def histogram(self, name: str, help: str = "", start: float = 1e-4,
-                  factor: float = 2.0, n_buckets: int = 24) -> Histogram:
-        return self._get_or_create(Histogram, name, help, start=start,
-                                   factor=factor, n_buckets=n_buckets)
+                  factor: float = 2.0, n_buckets: int = 24,
+                  labels=None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   start=start, factor=factor,
+                                   n_buckets=n_buckets)
 
     # -- readouts ---------------------------------------------------------
 
@@ -269,40 +332,63 @@ class MetricsRegistry:
     def to_prometheus(self) -> str:
         """Text exposition format (one scrape page).  Histograms emit the
         standard cumulative ``_bucket{le="..."}`` series + ``_sum`` +
-        ``_count``; +Inf is always present and equals ``_count``."""
-        lines: List[str] = []
+        ``_count``; +Inf is always present and equals ``_count``.
+        Labeled series render ``name{k="v"}``; every series of one
+        family emits CONTIGUOUSLY under a single HELP/TYPE header
+        (lazily-created tenant series register interleaved, but the
+        exposition format requires family grouping — strict parsers
+        reject split families)."""
+        families: Dict[str, List] = {}
         for m in self._metrics.values():
-            name = _sanitize(m.name)
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
-            if isinstance(m, Histogram):
-                cum = 0
-                for bound, c in zip(m.bounds, m.counts):
-                    cum += c
-                    lines.append(
-                        f'{name}_bucket{{le="{bound:.6g}"}} {cum}')
-                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{name}_sum {m.sum:.9g}")
-                lines.append(f"{name}_count {m.count}")
-            else:
-                v = m.value
-                lines.append(f"{name} {int(v) if v == int(v) else v}")
+            families.setdefault(m.name, []).append(m)
+        lines: List[str] = []
+        for fam in families.values():
+            name = _sanitize(fam[0].name)
+            helps = [m.help for m in fam if m.help]
+            if helps:
+                lines.append(f"# HELP {name} {helps[0]}")
+            lines.append(f"# TYPE {name} {fam[0].kind}")
+            for m in fam:
+                lines.extend(self._prom_series(name, m))
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _prom_series(name: str, m) -> List[str]:
+        """The sample lines of ONE series (header emitted by caller)."""
+        lines: List[str] = []
+        lbl = _prom_labels(m.labels)
+        if isinstance(m, Histogram):
+            cum = 0
+            for bound, c in zip(m.bounds, m.counts):
+                cum += c
+                le = _prom_labels(m.labels, f'le="{bound:.6g}"')
+                lines.append(f"{name}_bucket{le} {cum}")
+            inf = _prom_labels(m.labels, 'le="+Inf"')
+            lines.append(f"{name}_bucket{inf} {m.count}")
+            lines.append(f"{name}_sum{lbl} {m.sum:.9g}")
+            lines.append(f"{name}_count{lbl} {m.count}")
+        else:
+            v = m.value
+            lines.append(f"{name}{lbl} {int(v) if v == int(v) else v}")
+        return lines
 
     # -- snapshot (serving/snapshot.py) -----------------------------------
 
     def to_state(self) -> dict:
-        return {name: m.to_state() for name, m in self._metrics.items()}
+        return {key: m.to_state() for key, m in self._metrics.items()}
 
     @classmethod
     def from_state(cls, state: dict) -> "MetricsRegistry":
         reg = cls()
         kinds = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
-        for name, st in state.items():
-            m = kinds[st["kind"]](name, help=st.get("help", ""))
+        for key, st in state.items():
+            # pre-r12 states keyed by bare name and carried no labels
+            name = st.get("name", key)
+            m = kinds[st["kind"]](name, help=st.get("help", ""),
+                                  labels=st.get("labels") or None)
             m.load_state(st)
-            reg._metrics[name] = m
+            reg._metrics[key] = m
+            reg._family_kind.setdefault(name, m.kind)
         return reg
 
 

@@ -41,20 +41,28 @@ Lifecycle (r10): a request may carry a ``deadline_s`` (seconds from
 enqueue, measured on the engine's clock) — :meth:`pop_expired` removes
 overdue requests at queue-pop time, the engine expires overdue slots
 per-step.  :meth:`remove_waiting` serves ``engine.cancel`` for queued
-requests.  The waiting queue itself stays a plain deque; the BOUND
-(backpressure) lives in the engine, which converts an over-limit enqueue
-into an explicit ``rejected`` terminal instead of unbounded growth.
+requests.  The BOUND (backpressure) lives in the engine, which converts
+an over-limit enqueue into an explicit ``rejected`` terminal instead of
+unbounded growth.
+
+Queue ORDER is pluggable (r12, serving/tenancy.py): the scheduler
+delegates push/peek/pop/requeue-at-head to a
+:class:`~paddle_tpu.serving.tenancy.SchedulerPolicy` — FCFS by default
+(the pre-r12 deque, semantics unchanged), or weighted fair queueing over
+per-tenant virtual token counters for multi-tenant isolation.  The
+scheduler keeps owning slots, pages and the token budget; the policy
+only decides WHOSE request admits next.
 """
 
 from __future__ import annotations
 
-from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Tuple
+from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
 from .kv_pool import KVPool
+from .tenancy import SchedulerPolicy, make_policy
 
 
 class _RidCounter:
@@ -96,6 +104,7 @@ class Request:
     rid: int = field(default_factory=_next_rid)
     arrival: float = 0.0
     deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -120,6 +129,15 @@ class Request:
         self.t_admitted: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_last_token: Optional[float] = None
+        # fair-queueing service accounting (r12): ``vt_charged`` is the
+        # total first-time-served tokens already charged to the tenant's
+        # virtual counter; ``max_prompt_prefilled`` is the high-water
+        # mark of ORIGINAL-prompt positions ever prefilled.  Both are
+        # monotone across preemption, which is exactly what makes a
+        # recompute free: re-prefilling positions below the high-water
+        # mark raises neither, so ``uncharged_tokens`` stays 0 for them.
+        self.vt_charged = 0
+        self.max_prompt_prefilled = 0
 
     @property
     def prompt_len(self) -> int:
@@ -152,6 +170,26 @@ class Request:
         return (self.deadline_s is not None
                 and now - self.t_enqueue > self.deadline_s)
 
+    # -- fair-queueing service accounting (r12) ---------------------------
+
+    def note_prefill_progress(self, prefilled: int) -> None:
+        """``prefilled`` counts WORK-prompt positions with K/V written.
+        Only original-prompt positions past the high-water mark are
+        first-time service — generated tokens re-prefilled after a
+        preemption were already charged when they were decoded."""
+        self.max_prompt_prefilled = max(
+            self.max_prompt_prefilled, min(prefilled, self.prompt_len))
+
+    def uncharged_tokens(self) -> int:
+        """Tokens served for the first time since the last call: the
+        delta of the monotone ``max_prompt_prefilled + len(generated)``.
+        Recomputed (post-preemption) work never raises it, so the
+        tenant's virtual counter is charged exactly once per token."""
+        served = self.max_prompt_prefilled + len(self.generated)
+        delta = served - self.vt_charged
+        self.vt_charged = served
+        return delta
+
 
 @dataclass
 class Admission:
@@ -173,20 +211,32 @@ class Admission:
 
 
 class FCFSScheduler:
-    """First-come-first-served admission over a fixed slot array."""
+    """Iteration-level admission over a fixed slot array.  Queue ORDER
+    comes from ``policy`` (default: true FCFS); slots, pages and the
+    token budget are policy-independent.  The name survives from r08 —
+    every call site and test builds this class."""
 
     def __init__(self, n_slots: int, pool: KVPool,
-                 token_budget: Optional[int] = None):
+                 token_budget: Optional[int] = None,
+                 policy: Union[None, str, SchedulerPolicy] = None,
+                 tenants=None):
         self.n_slots = n_slots
         self.pool = pool
         # default budget: every slot decoding plus one flagship-sized
         # prefill chunk per step keeps step latency bounded without
         # starving admission
         self.token_budget = token_budget or (n_slots + 512)
-        self.waiting: Deque[Request] = deque()
+        self.policy: SchedulerPolicy = make_policy(policy, tenants)
         self._free_slots: List[int] = list(range(n_slots - 1, -1, -1))
 
     # -- queue ------------------------------------------------------------
+
+    @property
+    def waiting(self) -> List[Request]:
+        """Every waiting request, in the policy's deterministic
+        iteration order (FCFS: arrival order).  A fresh list each call —
+        mutate through the scheduler's methods, not this view."""
+        return list(self.policy)
 
     def add(self, request: Request) -> int:
         max_tokens = (self.pool.num_pages - 1) * self.pool.page_size
@@ -194,37 +244,50 @@ class FCFSScheduler:
             raise ValueError(
                 f"request {request.rid} needs {request.total_len} tokens; "
                 f"the pool holds {max_tokens} — raise num_pages/max_seq_len")
-        self.waiting.append(request)
+        self.policy.push(request)
         return request.rid
 
     def requeue(self, request: Request) -> None:
         """Put a PREEMPTED request back at the head of the queue: it was
         admitted before anything still waiting, so FCFS order puts it in
         front (multiple preemptions in one step requeue youngest-first,
-        each appendleft landing the older one ahead).  Bypasses the
-        engine's backpressure bound — the request was already accepted."""
-        self.waiting.appendleft(request)
+        each head-insert landing the older one ahead; under WFQ, the head
+        of its tenant's queue).  Bypasses the engine's backpressure bound
+        — the request was already accepted."""
+        self.policy.requeue_head(request)
 
     def remove_waiting(self, rid: int) -> Optional[Request]:
         """Remove and return the waiting request with ``rid`` (cancel),
         or None if it is not queued."""
-        for req in self.waiting:
-            if req.rid == rid:
-                self.waiting.remove(req)
-                return req
-        return None
+        return self.policy.remove(rid)
 
     def pop_expired(self, now: float) -> List[Request]:
         """Drop every waiting request whose deadline has passed (checked
         at queue-pop time, before this step's admissions)."""
-        expired = [r for r in self.waiting if r.expired(now)]
-        for req in expired:
-            self.waiting.remove(req)
-        return expired
+        return self.policy.pop_expired(now)
+
+    def quota_reject(self, tenant: Optional[str]) -> bool:
+        """Per-tenant backpressure (engine consults at enqueue)."""
+        return self.policy.quota_reject(tenant)
+
+    def charge(self, request: Request, n_tokens: int) -> None:
+        """Account ``n_tokens`` of first-time service to the request's
+        tenant (WFQ virtual counters; FCFS ignores)."""
+        self.policy.charge(request, n_tokens)
+
+    def load_waiting(self, requests: List[Request]) -> None:
+        """Snapshot-restore path: refill the queue without arrival side
+        effects (policy counters load separately)."""
+        self.policy.load_waiting(requests)
+
+    def note_restored_slot(self, request: Request) -> None:
+        """Snapshot-restore path: a slot came back occupied — give the
+        policy its residency accounting without re-admitting."""
+        self.policy.on_admit(request)
 
     @property
     def n_waiting(self) -> int:
-        return len(self.waiting)
+        return len(self.policy)
 
     @property
     def n_active(self) -> int:
@@ -232,7 +295,7 @@ class FCFSScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting) or self.n_active > 0
+        return len(self.policy) > 0 or self.n_active > 0
 
     # -- per-step decisions ----------------------------------------------
 
@@ -244,19 +307,23 @@ class FCFSScheduler:
         return max(1, min(chunk_tokens, self.token_budget - n_decoding))
 
     def schedule_step(self) -> List[Admission]:
-        """Admit FCFS from the waiting queue into free slots until slots
-        or pages run out.  Head-of-line blocking is intentional (FCFS
-        fairness): if the HEAD's pages don't fit we stop, we don't scan
-        deeper for a smaller request.  Page demand covers the WORK PROMPT
-        only (prompt + any preemption-survived tokens) — decode pages are
+        """Admit from the policy's queue into free slots until slots or
+        pages run out.  Head-of-line blocking is intentional (fairness):
+        if the chosen head's pages don't fit we stop, we don't scan
+        deeper for a smaller request — under WFQ "the head" is the
+        lowest-virtual-counter eligible tenant's oldest request, FCFS
+        within the tenant.  Page demand covers the WORK PROMPT only
+        (prompt + any preemption-survived tokens) — decode pages are
         allocated on demand by the engine, which preempts under pressure.
         Prefix-cache matching happens here, while this step's page
         arithmetic is decided: matched full pages are retained (shared)
         instead of allocated, and a partial-tail match rides along as the
         COW candidate."""
         admissions: List[Admission] = []
-        while self.waiting and self._free_slots:
-            req = self.waiting[0]
+        while self._free_slots:
+            req = self.policy.peek()
+            if req is None:
+                break
             work = req.work_prompt()
             cached: List[int] = []
             cow: Optional[Tuple[int, int]] = None
@@ -286,19 +353,28 @@ class FCFSScheduler:
                 break
             matched = len(cached) * self.pool.page_size + \
                 (cow[1] if cow else 0)
-            self.waiting.popleft()
+            popped = self.policy.pop()
+            if popped is not req:           # peek/pop must agree
+                raise AssertionError(
+                    "scheduler policy popped a different request than it "
+                    "peeked — admission page arithmetic is now wrong")
+            self.policy.on_admit(req)
             slot = self._free_slots.pop()
             admissions.append(Admission(slot=slot, request=req, pages=pages,
                                         cached=cached, cow=cow,
                                         matched=matched))
         return admissions
 
-    def release(self, slot: int, pages: List[int]) -> None:
-        """A request finished: its slot frees and every page reference it
-        held drops (shared prefix pages simply lose one reference; pages
-        reaching refcount 0 return to the free list unless the prefix
-        index keeps them reclaimable)."""
+    def release(self, slot: int, pages: List[int],
+                request: Optional[Request] = None) -> None:
+        """A request finished (or was preempted): its slot frees and
+        every page reference it held drops (shared prefix pages simply
+        lose one reference; pages reaching refcount 0 return to the free
+        list unless the prefix index keeps them reclaimable).  ``request``
+        lets the policy drop its residency accounting."""
         if slot in self._free_slots:
             raise ValueError(f"double release of slot {slot}")
         self.pool.release(pages)
         self._free_slots.append(slot)
+        if request is not None:
+            self.policy.on_release(request)

@@ -16,6 +16,10 @@ engine cheap and exact — ``snapshot_engine`` captures
     histogram buckets restore so the time-series stays monotonic across
     a restart (the tracer does NOT snapshot — a trace is an artifact of
     one process's timeline, like the FaultPlan),
+  * the scheduler policy's tenant state (r12): WFQ virtual token
+    counters and lazily-learned tenant configs reload, so a restarted
+    engine keeps the same fairness ledger — a tenant cannot launder its
+    served-token debt through a restart,
 
 all as plain numpy/python (picklable, no live device references).
 ``restore_engine(model, snap)`` rebuilds an engine around ``model`` —
@@ -46,25 +50,32 @@ from .prefix_cache import PrefixIndex
 from . import scheduler as _sched
 from .scheduler import Request
 
-SNAPSHOT_VERSION = 2
+#: v3 (r12): requests carry ``tenant`` + fair-queueing charge marks, the
+#: scheduler section carries the policy's state (WFQ virtual counters
+#: survive a restart).  v2 snapshots still load — the new fields default.
+SNAPSHOT_VERSION = 3
+_READABLE_VERSIONS = (2, 3)
 
 
 def _request_state(req: Request) -> dict:
     return dict(prompt=np.asarray(req.prompt, np.int32).copy(),
                 max_new_tokens=int(req.max_new_tokens), rid=int(req.rid),
                 arrival=float(req.arrival), deadline_s=req.deadline_s,
+                tenant=req.tenant,
                 t_enqueue=float(req.t_enqueue),
                 generated=list(req.generated),
                 n_preempted=int(req.n_preempted), seq=req.seq,
                 t_admitted=req.t_admitted,
                 t_first_token=req.t_first_token,
-                t_last_token=req.t_last_token)
+                t_last_token=req.t_last_token,
+                vt_charged=int(req.vt_charged),
+                max_prompt_prefilled=int(req.max_prompt_prefilled))
 
 
 def _request_from_state(st: dict) -> Request:
     req = Request(prompt=st["prompt"], max_new_tokens=st["max_new_tokens"],
                   rid=st["rid"], arrival=st["arrival"],
-                  deadline_s=st["deadline_s"])
+                  deadline_s=st["deadline_s"], tenant=st.get("tenant"))
     req.t_enqueue = st["t_enqueue"]
     req.generated = list(st["generated"])
     req.n_preempted = st["n_preempted"]
@@ -72,6 +83,8 @@ def _request_from_state(st: dict) -> Request:
     req.t_admitted = st.get("t_admitted")
     req.t_first_token = st.get("t_first_token")
     req.t_last_token = st.get("t_last_token")
+    req.vt_charged = int(st.get("vt_charged", 0))
+    req.max_prompt_prefilled = int(st.get("max_prompt_prefilled", 0))
     return req
 
 
@@ -113,7 +126,8 @@ def snapshot_engine(eng) -> dict:
             pending=[_finished_state(f) for f in eng._pending]),
         "scheduler": dict(
             waiting=[_request_state(r) for r in eng.scheduler.waiting],
-            free_slots=list(eng.scheduler._free_slots)),
+            free_slots=list(eng.scheduler._free_slots),
+            policy=eng.scheduler.policy.to_state()),
         "pool": dict(
             refcount=list(pool.refcount), free=list(pool._free),
             alloc_calls=int(pool.alloc_calls),
@@ -139,7 +153,7 @@ def restore_engine(model, snap: dict, **overrides):
     must match the snapshot or the mirrors won't fit."""
     from .engine import FinishedRequest, ServingEngine, _Slot
 
-    if snap.get("version") != SNAPSHOT_VERSION:
+    if snap.get("version") not in _READABLE_VERSIONS:
         raise ValueError(f"unknown snapshot version {snap.get('version')!r}")
     cfg = dict(snap["config"])
     cfg.update(overrides)
@@ -158,10 +172,15 @@ def restore_engine(model, snap: dict, **overrides):
     if ps["prefix"] is not None:
         pool.prefix = PrefixIndex.from_state(ps["prefix"])
 
-    eng.scheduler.waiting.clear()
-    for rstate in snap["scheduler"]["waiting"]:
-        eng.scheduler.waiting.append(_request_from_state(rstate))
+    eng.scheduler.load_waiting(
+        [_request_from_state(r) for r in snap["scheduler"]["waiting"]])
     eng.scheduler._free_slots = list(snap["scheduler"]["free_slots"])
+    # policy counters load AFTER the queue refill (load_waiting performs
+    # no arrival-time lifts, so the snapshotted counters land verbatim);
+    # v2 snapshots carry no policy section — fresh counters
+    pol_state = snap["scheduler"].get("policy")
+    if pol_state is not None:
+        eng.scheduler.policy.load_state(pol_state)
 
     # rebase request timestamps from the snapshotted clock onto this
     # engine's clock: shifted values preserve every relative interval
@@ -192,6 +211,7 @@ def restore_engine(model, snap: dict, **overrides):
         st.born_step = sstate["born_step"]
         _rebase(req)
         eng._slots[idx] = st
+        eng.scheduler.note_restored_slot(req)
 
     es = snap["engine"]
     eng._step_idx = es["step_idx"]

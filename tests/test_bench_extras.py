@@ -212,6 +212,55 @@ def test_overload_serving_bench_smoke():
     assert np.isfinite(res["goodput_ratio_bounded_vs_capacity"])
 
 
+def test_slo_serving_bench_smoke():
+    """Fast CPU smoke of the multi-tenant SLO bench (ISSUE r12
+    satellite): calibration + both overload legs (FCFS vs WFQ over 3
+    weighted tenants) complete, per-tenant accounting is total, shares
+    sum to ~1 where anything completed, and the weight-share targets are
+    recorded.  The +/-10-point share bar lives in the slow TPU test —
+    CPU timing noise at this size swamps real scheduling effects."""
+    res = bench._slo_serving_bench(hidden=48, layers=2, heads=2, vocab=128,
+                                   n_per_tenant=2, weights=(3.0, 1.0),
+                                   max_slots=2, page_size=8, prompt_len=8,
+                                   new_tokens=8, dtype="float32",
+                                   overload_factor=3.0, decode_block=2)
+    assert res["at_capacity"]["goodput_tokens_per_sec"] > 0
+    assert res["config"]["n_requests"] == 4
+    assert abs(sum(res["weight_shares"].values()) - 1.0) < 1e-6
+    for leg in ("fcfs", "wfq"):
+        pt = res[leg]["per_tenant"]
+        assert set(pt) == {"a", "b"}
+        done = sum(t["completed"] for t in pt.values())
+        exp = sum(t["expired"] for t in pt.values())
+        assert done + exp <= res["config"]["n_requests"]
+        if res[leg]["goodput_tokens_per_sec"] > 0:
+            assert abs(sum(t["share_of_completed_tokens"]
+                           for t in pt.values()) - 1.0) < 1e-6
+        # per-tenant labeled token counters made it into the registry
+        m = res[leg]["metrics"]
+        assert any(k.startswith("serving_tenant_tokens_generated.tenant=")
+                   for k in m)
+    assert np.isfinite(res["aggregate_ratio_wfq_vs_fcfs"])
+    assert res["max_share_error_wfq"] >= 0
+
+
+@pytest.mark.slow
+def test_slo_serving_bench_tpu_scale():
+    """The flagship-sized multi-tenant SLO point bench.py records on TPU
+    (marked slow).  The r12 acceptance bar lives here: under 3x-capacity
+    overload, WFQ per-tenant completed-token shares are within +/-10
+    points of the configured weight shares AND aggregate goodput stays
+    >= 0.95x FCFS — isolation without a throughput tax."""
+    res = bench._slo_serving_bench(hidden=1536, layers=24, heads=12,
+                                   vocab=50304, n_per_tenant=16,
+                                   weights=(3.0, 2.0, 1.0), max_slots=8,
+                                   page_size=64, prompt_len=96,
+                                   new_tokens=96, dtype="bfloat16",
+                                   overload_factor=3.0, decode_block=8)
+    assert res["max_share_error_wfq"] <= 0.10, res
+    assert res["aggregate_ratio_wfq_vs_fcfs"] >= 0.95, res
+
+
 @pytest.mark.slow
 def test_overload_serving_bench_tpu_scale():
     """The flagship-sized overload point bench.py records on TPU (marked

@@ -1,0 +1,350 @@
+"""Streaming HTTP front end (r12 tentpole): SSE token streaming, SLO
+status mapping, disconnect-cancel, scrape endpoints.
+
+Everything runs a REAL asyncio server on a loopback ephemeral port with
+a hand-rolled test client — the same stdlib-only posture as the front
+end itself.  The engine is tiny and greedy, so token streams are
+deterministic and comparable against the dense decoder reference.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.generation import build_generate_fn
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import ServingEngine, ServingFrontend, TenantConfig
+
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+           max_seq_len=96, dropout=0.0)
+
+
+def _engine(**kw):
+    paddle.seed(3)
+    model = GPTForPretraining(GPTConfig(**CFG))
+    model.eval()
+    eng = ServingEngine(model, max_slots=2, page_size=8, chunk_tokens=8,
+                        **kw)
+    # compile both programs before the server starts, so handler-visible
+    # latency is steps, not traces
+    eng.add_request(np.arange(4, dtype=np.int32), 2)
+    eng.run()
+    return model, eng
+
+
+# ---------------------------------------------------------------------------
+# tiny stdlib test client
+# ---------------------------------------------------------------------------
+
+
+def _http_bytes(method, path, payload=None):
+    body = json.dumps(payload).encode() if payload is not None else b""
+    return (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+async def _call(port, method, path, payload=None, timeout=60.0):
+    """One full request/response over a fresh connection; returns
+    (status, header dict, body bytes)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(_http_bytes(method, path, payload))
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), timeout)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+def _sse_events(body: bytes):
+    """['{json}', ..., '[DONE]'] from an event-stream body."""
+    out = []
+    for block in body.decode().split("\n\n"):
+        block = block.strip()
+        if block.startswith("data: "):
+            out.append(block[len("data: "):])
+    return out
+
+
+async def _drain(eng, timeout=30.0):
+    """Wait (cooperatively, next to the driver task) until the engine
+    has no work left."""
+    async def _wait():
+        while eng.has_work:
+            await asyncio.sleep(0.01)
+    await asyncio.wait_for(_wait(), timeout)
+
+
+# ---------------------------------------------------------------------------
+# the tests
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_sse_tokens_are_exactly_the_engine_tokens():
+    """Acceptance: the SSE chunk sequence == the final event's tokens ==
+    the dense greedy reference — streaming adds a transport, not a
+    different decode.  (Non-stream mode rides the same server session:
+    engine builds pay a double jit compile each, so tests share one
+    where their assertions allow.)"""
+    model, eng = _engine()
+    prompt = np.asarray([7, 3, 9, 11, 2, 5], np.int32)
+    max_tokens = 8
+    ref = np.asarray(build_generate_fn(model, max_tokens, greedy=True)(
+        prompt[None]))[0, len(prompt):]
+
+    async def main():
+        fe = await ServingFrontend(eng).start()
+        try:
+            streamed = await _call(
+                fe.port, "POST", "/v1/completions",
+                {"prompt": [int(t) for t in prompt],
+                 "max_tokens": max_tokens, "tenant": "a"})
+            plain = await _call(
+                fe.port, "POST", "/v1/completions",
+                {"prompt": [1, 2, 3], "max_tokens": 4, "stream": False})
+        finally:
+            await fe.stop()
+        return streamed, plain
+
+    (status, headers, body), (pstatus, _, pbody) = asyncio.run(main())
+    assert status == 200
+    assert headers["content-type"].startswith("text/event-stream")
+    events = _sse_events(body)
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    final = chunks[-1]
+    streamed = [c["token"] for c in chunks[:-1]]
+    assert [c["index"] for c in chunks[:-1]] == list(range(max_tokens))
+    assert streamed == final["tokens"]
+    np.testing.assert_array_equal(np.asarray(streamed, np.int32), ref)
+    assert final["finish_reason"] == "length"
+    assert final["usage"] == {"prompt_tokens": len(prompt),
+                              "completion_tokens": max_tokens}
+    # non-stream mode: one JSON body, same engine
+    assert pstatus == 200
+    doc = json.loads(pbody)
+    assert doc["finish_reason"] == "length"
+    assert len(doc["tokens"]) == 4
+
+
+def test_mid_stream_disconnect_cancels_and_frees_pages():
+    """Client walks away mid-stream -> the engine sees a cancel, the
+    request reaches its `cancelled` terminal, and every page it held is
+    released — nobody decodes to a dead socket."""
+    model, eng = _engine()
+
+    async def main():
+        fe = await ServingFrontend(eng).start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", fe.port)
+            writer.write(_http_bytes(
+                "POST", "/v1/completions",
+                {"prompt": [5, 6, 7, 8], "max_tokens": 48}))
+            await writer.drain()
+            # read until the first token chunk is on the wire…
+            buf = b""
+            while b'"token"' not in buf:
+                chunk = await asyncio.wait_for(reader.read(256), 30.0)
+                assert chunk, "server closed before first token"
+                buf += chunk
+            # …then hang up without reading the rest
+            writer.close()
+            await _drain(eng)
+        finally:
+            await fe.stop()
+
+    asyncio.run(main())
+    assert eng.stats["cancelled"] == 1
+    assert eng.stats["tokens_generated"] < 48 + 2  # warmup's 2 + partial
+    assert eng.scheduler.n_active == 0 and eng.pool.pages_in_use == 0
+    eng.check_invariants()
+
+
+def test_queue_overflow_maps_to_429():
+    """Global max_queue AND a tenant max_waiting quota both surface as
+    429 WITHOUT the engine ever enqueuing the request."""
+    model, eng = _engine(max_queue=0, policy="wfq",
+                         tenants={"cap": TenantConfig(max_waiting=0)})
+    # the warmup request itself was shed by max_queue=0 — baseline it
+    rejected0 = eng.stats["rejected"]
+
+    async def main():
+        fe = await ServingFrontend(eng).start()
+        try:
+            r1 = await _call(fe.port, "POST", "/v1/completions",
+                             {"prompt": [1, 2], "max_tokens": 4})
+            r2 = await _call(fe.port, "POST", "/v1/completions",
+                             {"prompt": [1, 2], "max_tokens": 4,
+                              "tenant": "cap"})
+        finally:
+            await fe.stop()
+        return r1, r2
+
+    (s1, h1, b1), (s2, _, _) = asyncio.run(main())
+    assert s1 == 429 and s2 == 429
+    assert h1.get("retry-after") == "1"
+    assert b"retry" in b1
+    # shed at the door: no rid minted, no rejected terminal recorded
+    assert eng.stats["rejected"] == rejected0
+    sc = eng.metrics.scalars()
+    assert sc["serving_http_requests.code=429.route=/v1/completions"] == 2
+
+
+def test_deadline_408_and_metrics_scrape():
+    """One server session: a queue-expired request maps to 408, then a
+    tenant completion, then /metrics parses as Prometheus exposition
+    with the per-tenant and per-route labeled series present."""
+    model, eng = _engine()
+
+    async def main():
+        fe = await ServingFrontend(eng).start()
+        try:
+            expired = await _call(fe.port, "POST", "/v1/completions",
+                                  {"prompt": [4, 4, 4], "max_tokens": 4,
+                                   "deadline_ms": 1e-4})
+            await _call(fe.port, "POST", "/v1/completions",
+                        {"prompt": [9, 9], "max_tokens": 3,
+                         "tenant": "acme"})
+            scrape = await _call(fe.port, "GET", "/metrics")
+        finally:
+            await fe.stop()
+        return expired, scrape
+
+    (status, _, body), (mstatus, mheaders, mbody) = asyncio.run(main())
+    assert status == 408
+    assert b"deadline" in body
+    assert eng.stats["expired"] == 1
+    assert eng.pool.pages_in_use == 0
+
+    assert mstatus == 200
+    assert mheaders["content-type"].startswith("text/plain")
+    lines = mbody.decode().splitlines()
+    # parses as exposition format: every sample line is "name{...} value"
+    samples = [ln for ln in lines if ln and not ln.startswith("#")]
+    for ln in samples:
+        name_part, _, value = ln.rpartition(" ")
+        assert name_part and float(value) is not None
+    assert ('serving_http_requests'
+            '{code="200",route="/v1/completions"} 1') in lines
+    assert ('serving_http_requests'
+            '{code="408",route="/v1/completions"} 1') in lines
+    assert 'serving_tenant_tokens_generated{tenant="acme"} 3' in lines
+    assert any(ln.startswith("serving_ttft_s_bucket") for ln in samples)
+
+
+def test_healthz_and_malformed_requests():
+    """One server session: /healthz shape, 404 without per-path counter
+    series, and every malformed-request flavor (non-id prompt, oversized
+    request, valid-JSON-non-dict body, garbage Content-Length) answered
+    with a 400 — never a bare connection drop."""
+    model, eng = _engine()
+
+    async def main():
+        fe = await ServingFrontend(eng, max_tenants=1).start()
+        try:
+            ok = await _call(fe.port, "GET", "/healthz")
+            missing = await _call(fe.port, "GET", "/nope")
+            bad = await _call(fe.port, "POST", "/v1/completions",
+                              {"prompt": "not ids", "max_tokens": 4})
+            huge = await _call(fe.port, "POST", "/v1/completions",
+                               {"prompt": [1] * 90, "max_tokens": 90})
+            nondict = await _call(fe.port, "POST", "/v1/completions",
+                                  [1, 2, 3])
+            bools = await _call(fe.port, "POST", "/v1/completions",
+                                {"prompt": [True, False],
+                                 "max_tokens": 2})
+            overflow = await _call(fe.port, "POST", "/v1/completions",
+                                   {"prompt": [2 ** 31], "max_tokens": 2})
+            badname = await _call(fe.port, "POST", "/v1/completions",
+                                  {"prompt": [1], "max_tokens": 2,
+                                   "tenant": "a b\nc"})
+            first = await _call(fe.port, "POST", "/v1/completions",
+                                {"prompt": [1], "max_tokens": 2,
+                                 "tenant": "t1"})
+            second = await _call(fe.port, "POST", "/v1/completions",
+                                 {"prompt": [1], "max_tokens": 2,
+                                  "tenant": "t2"})
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", fe.port)
+            writer.write(b"POST /v1/completions HTTP/1.1\r\n"
+                         b"Content-Length: abc\r\n\r\n")
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), 10.0)
+            writer.close()
+        finally:
+            await fe.stop()
+        # stop() restored the engine's token path to what it found
+        assert eng.on_token is None
+        return ok, missing, bad, huge, nondict, bools, overflow, \
+            badname, first, second, raw
+
+    (ok, missing, bad, huge, nondict, bools, overflow, badname, first,
+     second, raw) = asyncio.run(main())
+    status, _, body = ok
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["status"] == "ok"
+    assert doc["slots_total"] == 2 and doc["policy"] == "fcfs"
+    assert missing[0] == 404
+    assert bad[0] == 400 and b"token ids" in bad[2]
+    assert huge[0] == 400 and b"max_seq_len" in huge[2]
+    assert nondict[0] == 400 and b"JSON object" in nondict[2]
+    # JSON booleans are not token ids (bool subclasses int)
+    assert bools[0] == 400 and b"token ids" in bools[2]
+    # ids past int32 are a 400, not an OverflowError hangup
+    assert overflow[0] == 400 and b"int32" in overflow[2]
+    assert badname[0] == 400 and b"tenant" in badname[2]
+    # distinct-tenant cardinality cap (max_tenants=1): first name
+    # serves, the second is refused — names are accounts, not rids
+    assert first[0] == 200
+    assert second[0] == 400 and b"distinct tenants" in second[2]
+    assert raw.startswith(b"HTTP/1.1 400") and b"Content-Length" in raw
+    # arbitrary client paths must not mint per-path counter series
+    assert not any("/nope" in k for k in eng.metrics.scalars())
+
+
+def test_driver_death_aborts_streams_and_fails_healthz():
+    """A real exception escaping engine.step() must not leave the server
+    half-alive: the in-flight stream ends (no [DONE]), new completions
+    get 503, and /healthz flips to 503."""
+    model, eng = _engine()
+
+    async def main():
+        fe = await ServingFrontend(eng).start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", fe.port)
+            writer.write(_http_bytes("POST", "/v1/completions",
+                                     {"prompt": [2, 3], "max_tokens": 40}))
+            await writer.drain()
+            buf = b""
+            while b'"token"' not in buf:
+                buf += await asyncio.wait_for(reader.read(256), 30.0)
+
+            def boom():
+                raise RuntimeError("device fell over")
+
+            eng.step = boom
+            rest = await asyncio.wait_for(reader.read(), 10.0)
+            writer.close()
+            health = await _call(fe.port, "GET", "/healthz")
+            refused = await _call(fe.port, "POST", "/v1/completions",
+                                  {"prompt": [1], "max_tokens": 2})
+        finally:
+            await fe.stop()
+        return buf + rest, health, refused
+
+    stream, health, refused = asyncio.run(main())
+    assert b"[DONE]" not in stream          # stream aborted, not completed
+    assert health[0] == 503
+    assert json.loads(health[2])["status"] == "driver dead"
+    assert refused[0] == 503

@@ -142,6 +142,123 @@ def test_prometheus_exposition_format():
 
 
 # ---------------------------------------------------------------------------
+# labeled series (r12)
+# ---------------------------------------------------------------------------
+
+
+def test_labeled_series_are_distinct_and_flatten():
+    """labels= makes one instance per (name, labels) combination; label
+    order in the dict is irrelevant; scalars flatten as name.k=v."""
+    reg = MetricsRegistry()
+    a = reg.counter("toks", "per tenant", labels={"tenant": "a"})
+    b = reg.counter("toks", labels={"tenant": "b"})
+    plain = reg.counter("other")
+    assert a is not b
+    a.inc(3)
+    b.inc(5)
+    plain.inc()
+    # canonical identity: key order in the labels dict doesn't matter
+    assert reg.counter("toks", labels={"tenant": "a"}) is a
+    two = reg.counter("multi", labels={"x": "1", "y": "2"})
+    assert reg.counter("multi", labels={"y": "2", "x": "1"}) is two
+    sc = reg.scalars()
+    assert sc["toks.tenant=a"] == 3.0
+    assert sc["toks.tenant=b"] == 5.0
+    assert sc["other"] == 1.0
+    assert "multi.x=1.y=2" in sc
+    # one family, one kind: a labeled gauge under a counter family fails
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("toks", labels={"tenant": "c"})
+
+
+def test_labeled_prometheus_exposition():
+    reg = MetricsRegistry()
+    reg.counter("reqs", "by tenant", labels={"tenant": "a"}).inc(2)
+    reg.counter("reqs", labels={"tenant": "b", "reason": "eos"}).inc()
+    h = reg.histogram("lat", start=0.1, factor=2.0, n_buckets=2,
+                      labels={"tenant": "a"})
+    h.observe(0.05)
+    text = reg.to_prometheus()
+    lines = text.strip().splitlines()
+    assert 'reqs{tenant="a"} 2' in lines
+    # labels render sorted by key
+    assert 'reqs{reason="eos",tenant="b"} 1' in lines
+    # ONE TYPE header per family, not per labeled series
+    assert sum(1 for ln in lines if ln == "# TYPE reqs counter") == 1
+    assert 'lat_bucket{tenant="a",le="0.1"} 1' in lines
+    assert 'lat_bucket{tenant="a",le="+Inf"} 1' in lines
+    assert 'lat_count{tenant="a"} 1' in lines
+    # label values escape quotes/backslashes instead of corrupting lines
+    reg.gauge("g", labels={"q": 'say "hi"\\'}).set(1)
+    assert 'g{q="say \\"hi\\"\\\\"} 1' in reg.to_prometheus()
+
+
+def test_prometheus_families_contiguous_despite_interleaved_creation():
+    """Lazily-created per-tenant series register interleaved across
+    families; the exposition must still emit each family as ONE
+    contiguous block (strict parsers reject split families)."""
+    reg = MetricsRegistry()
+    reg.counter("toks", labels={"tenant": "a"}).inc()
+    reg.counter("terms", labels={"tenant": "a"}).inc()
+    reg.counter("toks", labels={"tenant": "b"}).inc()   # interleaved
+    reg.counter("terms", labels={"tenant": "b"}).inc()
+    lines = reg.to_prometheus().strip().splitlines()
+    # "# TYPE <name> <kind>" / "# HELP <name> ..." -> token 2;
+    # sample lines -> the name before any label brace
+    fam_of = [ln.split()[2] if ln.startswith("#")
+              else ln.split("{")[0] for ln in lines]
+    seen, last = set(), None
+    for fam in fam_of:
+        if fam != last:
+            assert fam not in seen, f"family {fam} split across the page"
+            seen.add(fam)
+            last = fam
+
+
+def test_labeled_series_state_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("t", "help", labels={"tenant": "a"}).inc(7)
+    reg.counter("t", labels={"tenant": "b"}).inc(1)
+    reg.gauge("plain").set(2.0)
+    h = reg.histogram("lat", labels={"tenant": "a"})
+    h.observe(0.01)
+    back = MetricsRegistry.from_state(reg.to_state())
+    assert back.scalars() == reg.scalars()
+    # restored labeled series resolve under the same (name, labels) and
+    # keep counting
+    c = back.counter("t", labels={"tenant": "a"})
+    assert c.value == 7 and c.help == "help"
+    c.inc()
+    assert back.scalars()["t.tenant=a"] == 8
+    assert back.counter("t", labels={"tenant": "b"}).value == 1
+    # prometheus rendering survives the round trip too
+    assert 'lat_count{tenant="a"} 1' in back.to_prometheus()
+
+
+def test_engine_per_tenant_labeled_metrics():
+    """Requests carrying tenant= produce labeled token/terminal series;
+    tenantless requests don't (the default path stays label-free)."""
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8, metrics=True,
+                        tenants={"a": 3.0, "b": 1.0})
+    rng = np.random.RandomState(7)
+    for tenant in ("a", "a", "b"):
+        eng.add_request(rng.randint(0, 512, (5,)).astype("int32"), 4,
+                        tenant=tenant)
+    out = eng.run()
+    sc = eng.metrics.scalars()
+    assert sc["serving_tenant_tokens_generated.tenant=a"] == 8
+    assert sc["serving_tenant_tokens_generated.tenant=b"] == 4
+    assert sc["serving_tenant_requests_terminal.reason=length.tenant=a"] == 2
+    assert sc["serving_tenant_requests_terminal.reason=length.tenant=b"] == 1
+    prom = eng.metrics.to_prometheus()
+    assert 'serving_tenant_tokens_generated{tenant="a"} 8' in prom
+    assert ('serving_tenant_requests_terminal'
+            '{reason="length",tenant="b"} 1') in prom
+    assert len(out) == 3
+
+
+# ---------------------------------------------------------------------------
 # trace recorder
 # ---------------------------------------------------------------------------
 
@@ -358,6 +475,9 @@ def test_engine_metrics_survive_snapshot_restore():
     before = eng.metrics.scalars()
     assert before["serving_steps"] == 4
     snap = eng.snapshot()
+    # default-policy engines snapshot the trivial FCFS policy state
+    # (v3) and restore across it without disturbance
+    assert snap["scheduler"]["policy"] == {"name": "fcfs"}
     eng2 = ServingEngine.restore(model, snap)
     assert eng2.metrics is not None
     assert eng2.metrics.scalars() == before
@@ -445,15 +565,39 @@ def test_engine_off_by_default_pays_nothing():
 #: absolute imports paddle_tpu.serving modules may use
 _ALLOWED_ROOTS = {"jax", "numpy"}
 
+#: stdlib modules that are SCOPED to specific serving files (r12): the
+#: network surface lives in frontend.py and ONLY there — the engine,
+#: scheduler, pool etc. must stay importable (and auditable) without any
+#: I/O machinery.  json predates the front end in tracing.py (the Chrome
+#: trace writer).  Keys are import roots, values the allowed basenames.
+_SCOPED_ROOTS = {
+    "asyncio": {"frontend.py"},
+    "http": {"frontend.py"},
+    "socket": {"frontend.py"},
+    "socketserver": set(),
+    "selectors": {"frontend.py"},
+    "ssl": set(),
+    "json": {"frontend.py", "tracing.py"},
+}
+
 
 def _stdlib(root: str) -> bool:
     return root in sys.stdlib_module_names
 
 
+def _allowed(root: str, fname: str) -> bool:
+    if root in _SCOPED_ROOTS:
+        return fname in _SCOPED_ROOTS[root]
+    return _stdlib(root) or root in _ALLOWED_ROOTS
+
+
 def test_serving_imports_only_jax_numpy_stdlib():
     """The serving package (metrics + tracing included) must stay
     importable with only jax/numpy/stdlib — observability cannot drag in
-    tensorboard/prometheus/opentelemetry client deps."""
+    tensorboard/prometheus/opentelemetry client deps — and the network
+    stdlib (asyncio/http/socket, plus json) is scoped to the front end:
+    a scheduler or engine change that starts talking to the network
+    fails HERE, not in a security review."""
     import paddle_tpu.serving as pkg
 
     pkg_dir = os.path.dirname(pkg.__file__)
@@ -466,15 +610,16 @@ def test_serving_imports_only_jax_numpy_stdlib():
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     root = alias.name.split(".")[0]
-                    if not (_stdlib(root) or root in _ALLOWED_ROOTS):
+                    if not _allowed(root, fname):
                         offenders.append((fname, alias.name))
             elif isinstance(node, ast.ImportFrom):
                 if node.level > 0:         # relative: stays in paddle_tpu
                     continue
                 root = (node.module or "").split(".")[0]
-                if not (_stdlib(root) or root in _ALLOWED_ROOTS):
+                if not _allowed(root, fname):
                     offenders.append((fname, node.module))
-    assert not offenders, f"non-stdlib absolute imports: {offenders}"
+    assert not offenders, \
+        f"disallowed/mis-scoped absolute imports: {offenders}"
 
 
 def test_serving_runtime_modules_loaded_clean():

@@ -1003,3 +1003,50 @@ def test_finished_request_reason_surface():
     rid = eng.add_request(p, 3)
     fin = eng.run()[rid]
     assert fin.reason == fin.finish_reason == "length" and fin.ok
+
+
+@pytest.mark.parametrize("mode,block", [("fp", 1), ("int8", 4)])
+def test_engine_on_token_streams_exactly_delivered_tokens(mode, block):
+    """r12 streaming hook: on_token(rid, token) fires once per emitted
+    token per slot per step, in delivery order — the streamed sequence
+    is token-for-token identical to the FinishedRequest tokens, across
+    fp/int8 and decode_block 1/4 (where a block emits up to k tokens per
+    dispatch), with EOS cut respected mid-block."""
+    int8 = mode == "int8"
+    model = _model()
+    streamed = {}
+
+    def on_token(rid, tok):
+        streamed.setdefault(rid, []).append(tok)
+
+    eng = ServingEngine(model, max_slots=2, page_size=8, int8=int8,
+                        decode_block=block, eos_token_id=7,
+                        on_token=on_token)
+    rng = np.random.RandomState(60)
+    rids = [eng.add_request(
+        rng.randint(0, 512, (int(rng.randint(3, 14)),)).astype("int32"),
+        int(rng.randint(3, 10))) for _ in range(5)]
+    out = eng.run()
+    assert set(out) == set(rids)
+    for rid in rids:
+        np.testing.assert_array_equal(
+            np.asarray(streamed.get(rid, []), np.int32), out[rid].tokens)
+    assert sum(len(v) for v in streamed.values()) == \
+        eng.stats["tokens_generated"]
+
+
+def test_engine_on_token_settable_post_ctor_and_chains_nothing():
+    """The hook is a plain settable attribute (the HTTP front end chains
+    onto it after construction) and None costs nothing."""
+    model = _model()
+    eng = ServingEngine(model, max_slots=1, page_size=8)
+    assert eng.on_token is None
+    rng = np.random.RandomState(61)
+    r1 = eng.add_request(rng.randint(0, 512, (4,)).astype("int32"), 3)
+    eng.run()
+    got = []
+    eng.on_token = lambda rid, tok: got.append((rid, tok))
+    r2 = eng.add_request(rng.randint(0, 512, (5,)).astype("int32"), 4)
+    out = eng.run()
+    assert [t for _, t in got] == list(out[r2].tokens)
+    assert all(rid == r2 for rid, _ in got) and r1 not in dict(got)

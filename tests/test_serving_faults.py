@@ -152,6 +152,65 @@ def test_chaos_terminal_totality_and_leak_freedom(mode, seed):
     assert by_reason["cancelled"] == eng.stats["cancelled"]
 
 
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 3])
+def test_chaos_wfq_terminal_totality_and_leak_freedom(seed):
+    """r12: the chaos contract holds under WEIGHTED FAIR QUEUEING too —
+    seeded faults (alloc exhaustion, phase exceptions, virtual latency)
+    against a 3-tenant WFQ engine with quotas: every request still ends
+    in exactly one terminal, the conftest fixture's check_invariants
+    (now auditing per-tenant residency + virtual counters) holds after
+    every step, and drain leaves zero pages — preemption/recompute under
+    faults cannot corrupt the fairness ledger."""
+    from paddle_tpu.serving import TenantConfig
+
+    model = _model()
+    plan = FaultPlan.random(seed, n_steps=30, p_alloc=0.20, p_raise=0.12,
+                            p_latency=0.15, max_latency_s=0.01,
+                            step_tick_s=1e-3)
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=8,
+                        chunk_tokens=8, max_queue=4, faults=plan,
+                        policy="wfq",
+                        tenants={"a": 3.0, "b": 1.0,
+                                 "c": TenantConfig(weight=1.0,
+                                                  max_resident=1)})
+    rng = np.random.RandomState(200 + seed)
+    tenants = ("a", "b", "c")
+    rids, terminals, steps = [], {}, 0
+
+    def make(i, deadline=None):
+        plen = int(rng.randint(3, 16))
+        new = int(rng.randint(3, 8))
+        return eng.add_request(
+            rng.randint(0, 512, (plen,)).astype("int32"), new,
+            deadline_s=deadline, tenant=tenants[i % len(tenants)])
+
+    for i in range(3):
+        rids.append(make(i, 0.02 if i == 1 else None))
+    while eng.has_work or steps < 12:
+        steps += 1
+        assert steps < 500, "WFQ chaos run failed to converge"
+        if steps in (2, 4, 6, 8):
+            rids.append(make(len(rids), 0.02 if steps == 4 else None))
+        if steps == 5:
+            eng.cancel(rids[0])
+        for fin in eng.step():
+            assert fin.rid not in terminals
+            terminals[fin.rid] = fin
+    assert set(terminals) == set(rids)
+    for fin in terminals.values():
+        assert fin.finish_reason in TERMINAL_REASONS
+    assert plan.injected["alloc_fail"] + plan.injected["raise"] > 0
+    assert eng.scheduler.n_active == 0 and eng.scheduler.n_waiting == 0
+    assert eng.pool.pages_in_use == 0
+    eng.check_invariants()
+    # the fairness ledger survived the chaos: counters finite, residency
+    # zeroed, and only charged for first-time service
+    pol = eng.scheduler.policy
+    assert all(v == 0 for v in pol.resident.values())
+    assert all(np.isfinite(v) and v >= 0 for v in pol.vt.values())
+
+
 def test_injected_growth_failure_stalls_without_cascade():
     """An injected alloc failure during decode growth while the pool
     still has free pages is a TRANSIENT fault, not pressure: the slot
