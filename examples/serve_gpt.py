@@ -27,6 +27,9 @@ CPU-runnable out of the box (tiny config); flags scale it up::
     python examples/serve_gpt.py --inject-faults 7   # deterministic chaos
     python examples/serve_gpt.py --metrics-dir /tmp/serve_metrics
         # + TensorBoard scalars, metrics.prom, Perfetto trace.json (r11)
+    python examples/serve_gpt.py --speculate 4
+        # r13: n-gram self-draft + multi-query verify; the summary line
+        # reports drafted/accepted/rejected and the acceptance rate
     python examples/serve_gpt.py --http 8000 --tenants a:3,b:1
         # r12: streaming HTTP front end (SSE /v1/completions, /metrics,
         # /healthz) with weighted-fair multi-tenant scheduling:
@@ -55,6 +58,10 @@ def main():
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--decode-block", type=int, default=1)
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="speculative decoding: n-gram self-draft up to K "
+                         "tokens/slot, verify in one multi-query dispatch "
+                         "(r13; requires greedy, excludes --decode-block)")
     ap.add_argument("--chunk-tokens", type=int, default=64,
                     help="chunked-prefill program width / per-step budget")
     ap.add_argument("--no-prefix-cache", action="store_true",
@@ -121,7 +128,7 @@ def main():
                         greedy=args.top_p >= 1.0, top_p=args.top_p,
                         eos_token_id=args.eos, int8=args.int8,
                         max_queue=args.max_queue, faults=faults,
-                        tenants=tenants,
+                        tenants=tenants, spec_k=args.speculate,
                         metrics=args.metrics_dir is not None,
                         trace=args.metrics_dir is not None)
     if args.http is not None:
@@ -203,6 +210,13 @@ def main():
           f"prompt tokens served from cached pages "
           f"({eng.prefix_hit_rate():.0%} hit rate), "
           f"{eng.pool.num_cached} pages cached for future requests")
+    if args.speculate:
+        acc = s["spec_accepted"] / max(s["spec_drafted"], 1)
+        print(f"speculation (k={args.speculate}): {s['spec_drafted']} "
+              f"drafted, {s['spec_accepted']} accepted, "
+              f"{s['spec_rejected']} rejected "
+              f"({acc:.0%} acceptance) in {s['decode_calls']} verify "
+              f"dispatches")
     print(f"lifecycle: {s['preemptions']} preemption(s) "
           f"({s['recompute_tokens']} tokens recomputed), "
           f"{s['rejected']} rejected, {s['expired']} expired, "

@@ -30,6 +30,13 @@ slot's block table.  Design (pallas_guide.md):
     interpreter (flash.py convention) and :func:`paged_attention_ref`
     is the jnp oracle making the same masking/dequant decisions — the
     parity contract tests/test_serving.py asserts.
+
+Speculative verify (r13): :func:`paged_attention_mq` scores a q_tile > 1
+block of draft positions per slot in one pass — each row attends to the
+block-table pages AND causally to the block's earlier rows (mask
+``page_pos <= lengths[b] + row``, the paged_prefill causal rule batched
+over slots).  q_tile == 1 dispatches to the single-query kernel above,
+so the r08 decode path stays the one lowering for that case.
 """
 
 from __future__ import annotations
@@ -66,6 +73,26 @@ def supported(n_heads: int, page_size: int, head_dim: int) -> bool:
         return False
     # VMEM: q (H, D) + K/V pages (H, ps, D) + scratch; tiny vs 16MB/core
     return n_heads * page_size * head_dim * 4 * 2 < 8 * 1024 * 1024
+
+
+def _pad_q_tile(q_tile: int) -> int:
+    """Sublane-align the verify block's query rows (pad rows are computed
+    and discarded; their outputs are garbage but finite — position 0 is
+    visible to every row, so no row's softmax ever empties)."""
+    return max(8, -(-q_tile // 8) * 8)
+
+
+def supported_mq(n_heads: int, page_size: int, head_dim: int,
+                 q_tile: int) -> bool:
+    """Shape gate for the multi-query verify kernel — the decode gate
+    plus the padded query block's VMEM footprint (same arithmetic as
+    paged_prefill.supported with chunk = padded q_tile)."""
+    if head_dim % 128 != 0 or page_size % 32 != 0:
+        return False
+    tp = _pad_q_tile(q_tile)
+    vmem = 4 * (2 * tp * n_heads * head_dim
+                + 2 * n_heads * page_size * head_dim)
+    return vmem < 8 * 1024 * 1024
 
 
 def _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
@@ -179,6 +206,140 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), *args)
 
 
+def _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                   page_size, scale, t):
+    """The online-softmax page step of the MULTI-query (speculative
+    verify) kernel: q_tile rows per slot, row i at global position
+    ``lengths[b] + i``, causally visible to page position j iff
+    ``j <= lengths[b] + i`` — the paged_prefill causal rule with the
+    slot's length as the chunk start, batched over slots like the decode
+    kernel.  Shared by the float and int8 entries (only how k/v
+    materialize in VMEM differs)."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)                       # (T, H, D)
+    s = jnp.einsum("thd,hsd->hts", q, k,
+                   preferred_element_type=jnp.float32) * scale  # (H, T, ps)
+    pos = p * jnp.int32(page_size) + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, page_size), 2)
+    qpos = len_ref[b] + jax.lax.broadcasted_iota(jnp.int32, (1, t, 1), 1)
+    s = jnp.where(pos <= qpos, s, jnp.float32(_NEG_INF))
+
+    m_prev = m_ref[...]                                    # (H, T)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new[:, :, None])
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=2)
+    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + jnp.einsum(
+        "hts,hsd->htd", pexp, v, preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finish():
+        out = acc_ref[...] / l_ref[...][:, :, None]        # (H, T, D)
+        o_ref[0] = jnp.einsum("htd->thd", out).astype(o_ref.dtype)
+
+
+def _mq_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref, *, page_size, scale, t):
+    k = k_ref[0].astype(jnp.float32)                       # (H, ps, D)
+    v = v_ref[0].astype(jnp.float32)
+    _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                   page_size, scale, t)
+
+
+# the int8 entry has its own arity (scale refs) but the same recurrence
+def _mq_kernel_int8(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                    o_ref, m_ref, l_ref, acc_ref, *, page_size, scale, t):
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (H, ps, D)
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+    _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                   page_size, scale, t)
+
+
+def paged_attention_mq(q, k_pages, v_pages, block_tables, lengths, *,
+                       k_scales=None, v_scales=None, scale=None,
+                       interpret: bool | None = None):
+    """Multi-query (speculative verify) decode attention through a paged
+    KV pool.
+
+    ``q`` (B, T, H, D) float — T = q_tile query rows per slot, row i at
+    global position ``lengths[b] + i``; ``lengths`` (B,) int32 counts the
+    positions valid BEFORE the block (the block's own K/V must already be
+    written into the pages, like paged_prefill).  Row i attends to page
+    position j iff ``j <= lengths[b] + i``: the history AND the block's
+    earlier rows, causally.  Other operands as :func:`paged_attention`.
+    Returns (B, T, H, D) in q.dtype.
+
+    T == 1 degenerates exactly to the single-query decode kernel (mask
+    ``j <= lengths[b]`` == ``j < lengths[b] + 1``), so this dispatches to
+    :func:`paged_attention` — the r08 path stays the one lowering for the
+    q_tile=1 case (asserted at the jaxpr level by the parity suite).
+    Callers gate on :func:`available`/:func:`supported_mq` first.
+    """
+    b, t, h, d = q.shape
+    if t == 1:
+        out = paged_attention(q[:, 0], k_pages, v_pages, block_tables,
+                              lengths + 1, k_scales=k_scales,
+                              v_scales=v_scales, scale=scale,
+                              interpret=interpret)
+        return out[:, None]
+    _, _, ps, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    scale = np.float32(scale)
+    if interpret is None:
+        interpret = not _backend_is_tpu()
+    int8 = k_scales is not None
+
+    tp = _pad_q_tile(t)
+    if tp != t:
+        q = jnp.pad(q, ((0, 0), (0, tp - t), (0, 0), (0, 0)))
+
+    q_spec = pl.BlockSpec((1, tp, h, d), lambda b, p, bt, ln: (b, 0, 0, 0))
+    pg_spec = pl.BlockSpec((1, h, ps, d),
+                           lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, h, ps, 1),
+                           lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
+    if int8:
+        kernel = functools.partial(_mq_kernel_int8, page_size=ps,
+                                   scale=scale, t=tp)
+        in_specs = [q_spec, pg_spec, sc_spec, pg_spec, sc_spec]
+        args = (q, k_pages, k_scales, v_pages, v_scales)
+    else:
+        kernel = functools.partial(_mq_kernel, page_size=ps, scale=scale,
+                                   t=tp)
+        in_specs = [q_spec, pg_spec, pg_spec]
+        args = (q, k_pages, v_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, tp, h, d),
+                               lambda b, p, bt, ln: (b, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((h, tp), jnp.float32),    # running max
+                        pltpu.VMEM((h, tp), jnp.float32),    # running denom
+                        pltpu.VMEM((h, tp, d), jnp.float32)],  # weighted acc
+    )
+    with _x64_off():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, tp, h, d), q.dtype),
+            interpret=interpret,
+        )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32), *args)
+    return out[:, :t]
+
+
 def gather_pages(pages, block_tables, scales=None):
     """Materialize each slot's paged KV as a dense (B, H, S, D) view
     (S = max_pages * page_size): ``pages[block_tables]`` + layout shuffle.
@@ -216,4 +377,39 @@ def paged_attention_ref(q, k_pages, v_pages, block_tables, lengths, *,
     s = jnp.where(mask[:, None], s, _NEG_INF)
     att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
     out = jnp.einsum("bhs,bhsd->bhd", att, v_eff)
+    return out.astype(q.dtype)
+
+
+def paged_attention_mq_ref(q, k_pages, v_pages, block_tables, lengths, *,
+                           k_scales=None, v_scales=None, scale=None):
+    """jnp reference for :func:`paged_attention_mq`: gathers the pages
+    dense and applies the same causal rule ``page_pos <= lengths[b] + i``
+    with the same dequant decision (gather_pages) — the CPU fallback and
+    the multi-query kernel's parity oracle.  T == 1 dispatches to
+    :func:`paged_attention_ref` (the masks coincide), keeping the r08
+    single-query reference the one definition of that case."""
+    b, t, h, d = q.shape
+    if t == 1:
+        out = paged_attention_ref(q[:, 0], k_pages, v_pages, block_tables,
+                                  lengths + 1, k_scales=k_scales,
+                                  v_scales=v_scales, scale=scale)
+        return out[:, None]
+    ps = k_pages.shape[2]
+    s_max = block_tables.shape[1] * ps
+    k_eff = gather_pages(k_pages, block_tables, k_scales)     # (B, H, S, D)
+    v_eff = gather_pages(v_pages, block_tables, v_scales)
+    s = jnp.einsum("bthd,bhsd->bhts", q, k_eff,
+                   preferred_element_type=jnp.float32)
+    if scale is None:
+        # divide, exactly as the dense decoder scales its scores — keeps
+        # the verify path bit-comparable to dense decode, not just close
+        s = s / np.sqrt(d).astype(np.float32)
+    else:
+        s = s * jnp.float32(scale)
+    pos = jnp.arange(s_max, dtype=jnp.int32)[None, None, :]
+    qpos = lengths[:, None, None] + jnp.arange(t, dtype=jnp.int32)[None, :,
+                                                                   None]
+    s = jnp.where((pos <= qpos)[:, None], s, _NEG_INF)
+    att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
+    out = jnp.einsum("bhts,bhsd->bthd", att, v_eff)
     return out.astype(q.dtype)

@@ -305,6 +305,32 @@ def _make_sampler(greedy: bool, temperature: float, top_k: int,
     return sample
 
 
+def spec_accept_greedy(pred, draft):
+    """The greedy rejection rule of speculative decoding (r13), shared by
+    the serving engine's verify step and its proof tests so the
+    acceptance decision has ONE definition.
+
+    The verify block holds ``[carry, draft[0], .., draft[n-1]]`` at
+    positions ``L .. L+n``; ``pred[i]`` is the target model's greedy
+    token AFTER consuming block row ``i`` — so draft token ``draft[i]``
+    is correct iff ``pred[i] == draft[i]``.  Accept the longest agreeing
+    prefix, then emit the target's own token at the first disagreement
+    (or the bonus token after a fully-accepted draft).  Every emitted
+    token is exactly what sequential greedy decode would have produced,
+    which is the whole exactness proof: speculation changes HOW MANY
+    positions one dispatch scores, never WHICH token any position gets.
+
+    Returns ``(n_accepted, emitted)`` — ``emitted`` is
+    ``draft[:n_accepted] + [pred[n_accepted]]``, between 1 and
+    ``len(draft) + 1`` tokens."""
+    n = 0
+    for d in draft:
+        if int(pred[n]) != int(d):
+            break
+        n += 1
+    return n, [int(t) for t in draft[:n]] + [int(pred[n])]
+
+
 def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
                       top_k: int = 0, greedy: bool = True,
                       top_p: float = 1.0,

@@ -37,6 +37,14 @@ Composes four pieces:
     ``/v1/completions`` per engine step via ``on_token``, ``/metrics``
     Prometheus scrape, ``/healthz``, disconnect→cancel, 429/408 SLO
     mapping);
+  * speculative decoding (r13): host-side n-gram self-drafting
+    (:class:`~paddle_tpu.serving.drafter.NGramDrafter`, prompt-lookup /
+    PLD) proposes up to ``spec_k`` tokens per slot, one multi-query
+    paged-attention verify dispatch scores every draft position
+    (kernels/paged_attention.py ``paged_attention_mq``), and greedy
+    rejection sampling accepts the longest agreeing prefix plus one
+    corrected token — token-for-token identical to non-speculative
+    decode (``ServingEngine(spec_k=...)``);
   * fault tolerance (r10): on-demand page growth with
     preempt-and-recompute under pool pressure, per-request deadlines /
     ``cancel`` / bounded-queue backpressure,
@@ -58,6 +66,7 @@ from .metrics import (Counter, Gauge, Histogram, MetricsFileExporter,
                       MetricsRegistry)
 from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, TraceRecorder,
                       attach_profiler, detach_profiler)
+from .drafter import NGramDrafter
 from .engine import TERMINAL_REASONS, FinishedRequest, ServingEngine
 from .faults import FaultPlan, InjectedFault
 from .snapshot import restore_engine, snapshot_engine
@@ -71,4 +80,4 @@ __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "attach_profiler", "detach_profiler", "PID_ENGINE",
            "PID_REQUESTS", "PID_HOST",
            "SchedulerPolicy", "FCFSPolicy", "WFQPolicy", "TenantConfig",
-           "DEFAULT_TENANT", "ServingFrontend"]
+           "DEFAULT_TENANT", "ServingFrontend", "NGramDrafter"]

@@ -1,0 +1,77 @@
+"""Host-side n-gram self-drafting for speculative decoding (r13).
+
+Prompt-lookup decoding (the PLD / vLLM ``ngram`` speculator, the
+weight-free end of the Medusa/EAGLE draft-model line): the draft "model"
+is the request's OWN token history.  Repetitive and extractive workloads
+— code, quotes, structured extraction, templated answers — keep emitting
+spans that already occurred earlier in prompt + generated; matching the
+history's trailing n-gram against its earlier occurrences and proposing
+the continuation that followed the most recent match recovers those
+spans without any extra weights or device work.
+
+The drafter is deliberately HOST-ONLY and model-free:
+
+  * pure numpy over the request's ``work_prompt()`` (prompt + generated)
+    — no device dispatch, no state of its own, so draft buffers are
+    always reconstructible from request history (snapshot/restore needs
+    nothing from it, and a step fault between drafting and verify simply
+    re-drafts next step);
+  * deterministic: same history -> same proposal, which is what lets the
+    engine's speculative greedy decode stay token-for-token identical to
+    non-speculative decode (the verify pass, not the drafter, decides
+    what is emitted — a bad draft only costs speed, never correctness);
+  * duck-typed: the engine accepts any object with
+    ``draft(history, max_tokens) -> np.ndarray`` (tests inject oracle /
+    adversarial drafters to pin the full-accept and full-reject paths).
+
+Stays jax/numpy/stdlib-only — enforced by the serving AST import guard
+(tests/test_metrics.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NGramDrafter:
+    """Propose up to ``spec_k`` tokens by prompt lookup.
+
+    Matches the history's trailing n-gram for ``n`` from ``max_ngram``
+    down to ``min_ngram`` (longer matches are more predictive, so they
+    win); within one ``n`` the MOST RECENT earlier occurrence wins (local
+    context beats distant context).  The proposal is the ``spec_k``
+    tokens that followed the match — possibly overlapping the suffix
+    itself, which is exactly right for periodic continuations.  No match
+    at any ``n`` proposes nothing: the engine's verify step then runs as
+    a plain one-token decode.
+    """
+
+    def __init__(self, spec_k: int, max_ngram: int = 3, min_ngram: int = 1):
+        if spec_k < 1:
+            raise ValueError("spec_k must be >= 1")
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.spec_k = int(spec_k)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def draft(self, history, max_tokens: int | None = None) -> np.ndarray:
+        """Up to ``min(spec_k, max_tokens)`` proposed continuation tokens
+        of ``history`` (1-D int tokens), possibly empty.  O(len * ngram)
+        numpy per call — noise next to one device dispatch."""
+        h = np.asarray(history, np.int32).reshape(-1)
+        k = self.spec_k if max_tokens is None else min(self.spec_k,
+                                                       int(max_tokens))
+        if k < 1 or h.size < self.min_ngram + 1:
+            return np.zeros((0,), np.int32)
+        for n in range(min(self.max_ngram, h.size - 1),
+                       self.min_ngram - 1, -1):
+            suffix = h[h.size - n:]
+            # windows starting before the trailing suffix itself
+            wins = np.lib.stride_tricks.sliding_window_view(
+                h, n)[: h.size - n]
+            hits = np.flatnonzero((wins == suffix).all(axis=1))
+            if hits.size:
+                j = int(hits[-1]) + n
+                return h[j:j + k].copy()
+        return np.zeros((0,), np.int32)

@@ -93,9 +93,11 @@ from ..models.generation import (
     _decoder_setup,
     _lm_head,
     _make_sampler,
+    spec_accept_greedy,
 )
 from ..kernels import paged_attention as pa
 from ..kernels import paged_prefill as pp
+from .drafter import NGramDrafter
 from .faults import FaultPlan, InjectedFault
 from .kv_pool import KVPool
 from .metrics import MetricsRegistry
@@ -157,6 +159,11 @@ class _Slot:
         self.base_len = base_len      # work-prompt length at admission
         self.prefilled = prefilled    # work positions with K/V in pages
         self.started = False          # first token sampled; decoding
+        # speculative draft buffer (r13): host-only, overwritten by every
+        # spec step's fresh proposal — reconstructible from the request
+        # history, so snapshots never capture it and a step fault between
+        # drafting and verify costs nothing but the proposal
+        self.draft: List[int] = []
 
 
 class ServingEngine:
@@ -207,6 +214,20 @@ class ServingEngine:
     on it.  Requests carry ``tenant=`` through :meth:`add_request`;
     per-tenant token/terminal counters land in the metrics registry as
     labeled series (``serving_tenant_*{tenant="..."}``).
+
+    r13 speculative-decoding knobs: ``spec_k`` > 0 proposes up to that
+    many draft tokens per slot per step from the request's own history
+    (:class:`~paddle_tpu.serving.drafter.NGramDrafter` with
+    ``spec_ngram`` as the longest n-gram matched; ``drafter=`` injects
+    any object with ``draft(history, max_tokens)``), verifies them all
+    in ONE multi-query paged-attention dispatch
+    (``kernels/paged_attention.paged_attention_mq``) and accepts the
+    longest agreeing prefix plus one corrected token — greedy output is
+    token-for-token identical to ``spec_k=0``, only faster when drafts
+    accept.  Requires greedy sampling, replaces ``decode_block`` fusion,
+    and bills WFQ tenants by ACCEPTED tokens only.  Acceptance telemetry:
+    ``stats["spec_drafted"/"spec_accepted"/"spec_rejected"]`` and the
+    ``serving_spec_acceptance_rate`` per-request histogram.
     """
 
     def __init__(self, model, *, max_slots: int = 8, page_size: int = 32,
@@ -225,7 +246,8 @@ class ServingEngine:
                  clock: Optional[Callable[[], float]] = None,
                  metrics=None, trace=None,
                  policy=None, tenants=None,
-                 on_token: Optional[Callable[[int, int], None]] = None):
+                 on_token: Optional[Callable[[int, int], None]] = None,
+                 spec_k: int = 0, spec_ngram: int = 3, drafter=None):
         cfg = model.cfg
         self.cfg = cfg
         # decode_block > 1 fuses that many decode steps into ONE dispatched
@@ -235,6 +257,27 @@ class ServingEngine:
         # once per block instead of once per token.  1 = pure
         # admit-every-step continuous batching (the parity-test mode).
         self.decode_block = max(1, int(decode_block))
+        # spec_k > 0 turns the decode dispatch SPECULATIVE (r13): a
+        # host-side drafter proposes up to spec_k tokens per slot from the
+        # request's own history, one verify dispatch scores carry + all
+        # draft positions, and the greedy rejection rule accepts the
+        # longest agreeing prefix plus the target's correction token —
+        # 1..spec_k+1 tokens per dispatch, token-for-token identical to
+        # non-speculative greedy decode.
+        self.spec_k = max(0, int(spec_k))
+        if self.spec_k:
+            if not greedy:
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) requires greedy "
+                    "sampling — the longest-agreeing-prefix rule is the "
+                    "greedy special case of rejection sampling")
+            if self.decode_block > 1:
+                raise ValueError(
+                    "spec_k > 0 replaces decode_block fusion: the verify "
+                    "dispatch already scores spec_k+1 positions per step")
+        self._drafter = drafter if drafter is not None else (
+            NGramDrafter(self.spec_k, max_ngram=spec_ngram)
+            if self.spec_k else None)
         self.params, _, self.int8 = _decoder_setup(model, int8=int8)
         self.n_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
@@ -278,9 +321,12 @@ class ServingEngine:
                 cfg.num_heads, page_size, self.head_dim)
             self._use_prefill_kernel = pp.available() and pp.supported(
                 cfg.num_heads, page_size, self.head_dim, self.chunk_tokens)
+            self._use_spec_kernel = pa.available() and pa.supported_mq(
+                cfg.num_heads, page_size, self.head_dim, self.spec_k + 1)
         else:
             self._use_kernel = bool(use_paged_kernel)
             self._use_prefill_kernel = bool(use_paged_kernel)
+            self._use_spec_kernel = bool(use_paged_kernel)
 
         # ctor echo for snapshot/restore (serving/snapshot.py): enough to
         # rebuild an equivalent engine around the captured state.  faults
@@ -294,6 +340,11 @@ class ServingEngine:
             decode_block=decode_block, use_paged_kernel=use_paged_kernel,
             chunk_tokens=chunk_tokens, prefix_cache=prefix_cache,
             max_queue=max_queue,
+            # spec_k/spec_ngram rebuild the NGramDrafter at restore; a
+            # custom drafter instance is like faults/clock — not
+            # snapshot-portable (draft buffers themselves are transient
+            # host state, reconstructible from request history)
+            spec_k=self.spec_k, spec_ngram=spec_ngram,
             # the POLICY NAME, not the instance: a restored engine
             # rebuilds the named policy and reloads its counters from
             # the snapshot's scheduler state (a custom SchedulerPolicy
@@ -330,7 +381,13 @@ class ServingEngine:
                       "last_decode_s": 0.0,
                       "preemptions": 0, "recompute_tokens": 0,
                       "rejected": 0, "expired": 0, "cancelled": 0,
-                      "step_faults": 0}
+                      "step_faults": 0,
+                      # speculative decoding (r13): drafted = proposals
+                      # scored by verify, accepted + rejected = drafted;
+                      # the bonus/correction token is NOT counted (it is
+                      # ordinary decode output, speculation or not)
+                      "spec_drafted": 0, "spec_accepted": 0,
+                      "spec_rejected": 0}
         # observability (r11): both default OFF — the hot loop pays
         # nothing unless asked to measure itself
         self.metrics: Optional[MetricsRegistry] = None
@@ -347,6 +404,7 @@ class ServingEngine:
         self._decode_fn = self._build_decode()
         self._prefill_fn = self._build_prefill()
         self._cow_fn = self._build_cow()
+        self._verify_fn = self._build_verify() if self.spec_k else None
 
     # -- device programs --------------------------------------------------
 
@@ -437,6 +495,70 @@ class ServingEngine:
             return bufs, toks_all                                  # (k, S)
 
         return jax.jit(decode, donate_argnums=(1,))
+
+    def _attend_spec(self, q, bufs, li, table, lengths):
+        """Multi-query verify attention for layer ``li`` — kernel or jnp
+        ref.  ``lengths`` counts the positions valid BEFORE the verify
+        block (the paged_attention_mq contract)."""
+        if self.int8:
+            kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
+        else:
+            kw = {}
+        fn = (pa.paged_attention_mq if self._use_spec_kernel
+              else pa.paged_attention_mq_ref)
+        return fn(q, bufs["k"][li], bufs["v"][li], table, lengths, **kw)
+
+    def _build_verify(self):
+        """The speculative verify program: ONE dispatch embeds each
+        slot's ``[carry, draft_0 .. draft_{k-1}]`` block at positions
+        ``len .. len+k``, scatters all rows' K/V into the slot's pages
+        (same quantize/scatter as decode — rows past the slot's draft
+        count and inactive lanes park on the null page), runs multi-query
+        paged attention (each row sees history + earlier block rows,
+        causally), projects every row and samples greedily.  The host
+        applies the rejection rule to the returned (S, k+1) predictions.
+
+        Rejected rows leave stale K/V at positions past the accepted
+        prefix; that is safe by construction: the next step's scatter
+        REWRITES positions ``len' .. len'+k'`` before attending, and no
+        query row ever attends past its own position — the same masking
+        argument that makes null-page garbage harmless."""
+        n_heads, eps, ps = self.n_heads, self.eps, self.page_size
+        maxp, t = self.max_pages, self.spec_k + 1
+
+        def verify(p, bufs, toks, draft, n_draft, lengths, table, key):
+            self.stats["decode_traces"] += 1  # python side effect: per trace
+            s = toks.shape[0]
+            block = jnp.concatenate([toks[:, None], draft], axis=1)  # (S, T)
+            pos = lengths[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            # pad rows of short drafts can index positions past the table;
+            # clamp for the position embedding (their outputs are unused)
+            x = p["wte"][block] + p["wpe"][
+                jnp.minimum(pos, p["wpe"].shape[0] - 1)]         # (S, T, h)
+            # rows beyond the slot's draft count — and every row of a
+            # lane not decoding this step (n_draft == -1) — write to the
+            # null page, exactly like inactive decode lanes
+            row_ok = jnp.arange(t, dtype=jnp.int32)[None, :] <= \
+                n_draft[:, None]
+            page_idx = jnp.minimum(pos // ps, maxp - 1)
+            rows = jnp.where(
+                row_ok, jnp.take_along_axis(table, page_idx, axis=1), 0)
+            offs = pos % ps
+            for li, bp in enumerate(p["blocks"]):
+                q, kb, vb = _block_qkv(bp, x, n_heads, eps)  # q (S,H,T,D)
+                k1 = jnp.swapaxes(kb, 1, 2)                  # (S, T, H, D)
+                v1 = jnp.swapaxes(vb, 1, 2)
+                bufs = self._scatter_kv(bufs, li, rows, offs, k1, v1)
+                out = self._attend_spec(jnp.swapaxes(q, 1, 2), bufs, li,
+                                        table, lengths)
+                out = out.reshape(s, t, -1).astype(x.dtype)
+                x = _block_finish(bp, x, out, eps)
+            logits = _lm_head(p, x, eps)                     # (S, T, V)
+            key, sub = jax.random.split(key)
+            pred = self._sample(logits.reshape(s * t, -1), sub)
+            return bufs, pred.reshape(s, t).astype(jnp.int32)
+
+        return jax.jit(verify, donate_argnums=(1,))
 
     def _build_prefill(self):
         n_heads, eps, ps = self.n_heads, self.eps, self.page_size
@@ -609,6 +731,15 @@ class ServingEngine:
             "cow": c("serving_cow_clones", "copy-on-write page clones"),
             "step_faults": c("serving_step_faults",
                              "injected mid-step exceptions absorbed"),
+            "spec_drafted": c("serving_spec_drafted_tokens",
+                              "draft tokens scored by verify dispatches"),
+            "spec_accepted": c("serving_spec_accepted_tokens",
+                               "draft tokens the verify pass accepted"),
+            "spec_rejected": c("serving_spec_rejected_tokens",
+                               "draft tokens the verify pass rejected"),
+            "spec_accept_rate": h("serving_spec_acceptance_rate",
+                                  "per-request accepted/drafted at "
+                                  "terminal (requests that drafted)"),
             "alloc_calls": c("serving_alloc_calls",
                              "KVPool.alloc lease attempts"),
             "alloc_failures": c("serving_alloc_failures",
@@ -706,6 +837,9 @@ class ServingEngine:
         if self.metrics is not None:
             self._m["terminal"][reason].inc()
             self._m["e2e"].observe(self._now() - req.t_enqueue)
+            if req.spec_drafted > 0:
+                self._m["spec_accept_rate"].observe(
+                    req.spec_accepted / req.spec_drafted)
             if req.tenant is not None:
                 self._tenant_counter("serving_tenant_requests_terminal",
                                      "per-tenant terminals by reason",
@@ -870,7 +1004,8 @@ class ServingEngine:
         samples its next token and joins this step's decode batch."""
         n_decoding = sum(1 for s in self._slots
                          if s is not None and s.started)
-        budget = self.scheduler.prefill_budget(n_decoding, self.chunk_tokens)
+        budget = self.scheduler.prefill_budget(
+            n_decoding, self.chunk_tokens, decode_cost=1 + self.spec_k)
         partial = sorted(
             (i for i, s in enumerate(self._slots)
              if s is not None and not s.started),
@@ -1041,7 +1176,10 @@ class ServingEngine:
                                ("recompute_tokens", "recompute"),
                                ("prefix_hit_tokens", "prefix_hit"),
                                ("prompt_tokens", "prompt_tokens"),
-                               ("step_faults", "step_faults")):
+                               ("step_faults", "step_faults"),
+                               ("spec_drafted", "spec_drafted"),
+                               ("spec_accepted", "spec_accepted"),
+                               ("spec_rejected", "spec_rejected")):
             m[name].set_total(s[stat_key])
         m["alloc_calls"].set_total(self.pool.alloc_calls)
         m["alloc_failures"].set_total(self.pool.alloc_failures)
@@ -1085,6 +1223,8 @@ class ServingEngine:
             phase["decode"] = (t_d, time.perf_counter() - t_d)
 
     def _decode_step(self, finished: List[FinishedRequest]) -> None:
+        if self.spec_k:
+            return self._spec_decode_step(finished)
         # decode-page growth, oldest first so preemption victims are
         # always younger than the grower
         order = sorted((i for i, s in enumerate(self._slots)
@@ -1147,6 +1287,100 @@ class ServingEngine:
                     self._tok[idx] = int(toks_all[consumed - 1, idx])
                     self._len[idx] += consumed
 
+    def _spec_decode_step(self, finished: List[FinishedRequest]) -> None:
+        """One speculative iteration over the started slots: draft from
+        each request's history, grow pages for the whole verify block
+        (carry + drafts — up to spec_k+1 positions, the same on-demand
+        growth/preemption path as fused decode), one verify dispatch,
+        then the greedy rejection rule advances each slot by
+        ``accepted + 1`` tokens.  The draft is capped at
+        ``remaining_new - 1`` so even full acceptance plus the bonus
+        token lands exactly on ``max_new_tokens``."""
+        k = self.spec_k
+        order = sorted((i for i, s in enumerate(self._slots)
+                        if s is not None and s.started),
+                       key=lambda i: self._slots[i].seq)
+        # -1 marks a lane not decoding this step (empty slot, mid-prefill,
+        # stalled growth): the verify program masks all its rows
+        n_draft = np.full((self.max_slots,), -1, np.int32)
+        draft = np.zeros((self.max_slots, k), np.int32)
+        run: List[int] = []
+        for idx in order:
+            if self._slots[idx] is None:      # preempted by an earlier grow
+                continue
+            st = self._slots[idx]
+            cap = min(k, st.request.remaining_new - 1)
+            if cap > 0:
+                prop = np.asarray(
+                    self._drafter.draft(st.request.work_prompt(), cap),
+                    np.int32).reshape(-1)
+                st.draft = [int(v) for v in prop[:cap]]
+            else:
+                st.draft = []
+            if self._grow_pages(idx, len(st.draft) + 1):
+                run.append(idx)
+                n_draft[idx] = len(st.draft)
+                if st.draft:
+                    draft[idx, :len(st.draft)] = st.draft
+        if not run:
+            return
+        # mid-verify fault point: drafts proposed + pages grown, dispatch
+        # not yet issued — an injected fault here leaves the draft
+        # buffers populated; the next step's proposal overwrites them
+        # (check_invariants audits their bounds meanwhile)
+        self._fault_point("verify")
+        t_c = time.perf_counter()
+        self.pool.buffers, pred = self._verify_fn(
+            self.params, self.pool.buffers, jnp.asarray(self._tok),
+            jnp.asarray(draft), jnp.asarray(n_draft),
+            jnp.asarray(self._len), jnp.asarray(self._table),
+            self._next_key())
+        self.stats["decode_calls"] += 1
+        pred = np.asarray(pred)                      # (max_slots, k+1)
+        if self.metrics is not None:
+            self._m["decode_call_s"].observe(time.perf_counter() - t_c)
+        now = self._now()
+        for idx in run:
+            st = self._slots[idx]
+            req = st.request
+            nd = len(st.draft)
+            n_acc, emitted = spec_accept_greedy(pred[idx], st.draft)
+            st.draft = []
+            self.stats["spec_drafted"] += nd
+            self.stats["spec_accepted"] += n_acc
+            self.stats["spec_rejected"] += nd - n_acc
+            req.spec_drafted += nd
+            req.spec_accepted += n_acc
+            reason = None
+            n_new = 0
+            for tok in emitted:
+                st.tokens.append(tok)
+                self._emit_token(req, tok)
+                n_new += 1
+                self.stats["tokens_generated"] += 1
+                if (self.eos_token_id is not None
+                        and tok == self.eos_token_id):
+                    reason = "eos"
+                    break
+            self._tokens_this_step += n_new
+            self._charge_service(req)
+            if (self.metrics is not None and n_new
+                    and req.t_last_token is not None):
+                self._m["tbt"].observe((now - req.t_last_token) / n_new)
+            req.t_last_token = now
+            if reason is None and len(st.tokens) >= req.max_new_tokens:
+                reason = "length"
+            if reason is not None:
+                finished.append(self._finish(idx, reason))
+            else:
+                # mirror the DEVICE state: positions len .. len+n_new-1
+                # now hold the accepted block rows' K/V (the carry token
+                # and the accepted drafts — exactly the tokens sequential
+                # decode would have written there); the new carry is the
+                # bonus/correction token, whose K/V the next step writes
+                self._tok[idx] = emitted[n_new - 1]
+                self._len[idx] += n_new
+
     def check_invariants(self) -> None:
         """Page-leak / refcount / scheduler-consistency audit.  The pool's
         internal bookkeeping must balance, the refcount total must equal
@@ -1177,6 +1411,24 @@ class ServingEngine:
                 raise AssertionError(
                     f"slot {i} occupancy disagrees with the scheduler's "
                     "free-slot list")
+        # speculative draft buffers (r13): a slot's draft must stay
+        # within the engine's spec window and the request's remaining
+        # budget, and only DECODING slots may hold one — whatever step
+        # fault landed between drafting and verify
+        for i, s in enumerate(self._slots):
+            if s is None or not s.draft:
+                continue
+            if len(s.draft) > self.spec_k:
+                raise AssertionError(
+                    f"slot {i} holds {len(s.draft)} draft tokens; "
+                    f"spec_k is {self.spec_k}")
+            if not s.started:
+                raise AssertionError(
+                    f"slot {i} holds draft tokens but is still prefilling")
+            if len(s.draft) >= s.request.remaining_new:
+                raise AssertionError(
+                    f"slot {i} draft of {len(s.draft)} could overshoot "
+                    f"the remaining budget {s.request.remaining_new}")
         # policy-side accounting (r12): per-tenant residency counts must
         # match the slots, virtual counters must stay finite/non-negative
         self.scheduler.policy.check(

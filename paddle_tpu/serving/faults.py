@@ -44,7 +44,11 @@ from typing import Dict, Iterable, Optional, Set
 import numpy as np
 
 #: The engine's intra-step injection points, in execution order.
-PHASES = ("admit", "prefill", "decode")
+#: "verify" (r13) fires INSIDE a speculative decode step — after drafts
+#: are proposed and pages grown, before the verify dispatch — so chaos
+#: runs exercise the draft-buffers-populated-but-unverified state; a
+#: non-speculative engine never reaches it (the fault stays silent).
+PHASES = ("admit", "prefill", "verify", "decode")
 
 
 class InjectedFault(RuntimeError):

@@ -138,6 +138,15 @@ class Request:
         # mark raises neither, so ``uncharged_tokens`` stays 0 for them.
         self.vt_charged = 0
         self.max_prompt_prefilled = 0
+        # speculative decoding observability (r13): draft tokens this
+        # request's verify dispatches scored / accepted.  Survive
+        # preemption and snapshot (they are cumulative request history);
+        # the engine observes accepted/drafted into the acceptance-rate
+        # histogram at the terminal.  NOT service accounting: WFQ charges
+        # through ``uncharged_tokens`` — only ACCEPTED tokens ever enter
+        # ``generated``, so rejected drafts bill zero by construction.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     @property
     def prompt_len(self) -> int:
@@ -299,12 +308,20 @@ class FCFSScheduler:
 
     # -- per-step decisions ----------------------------------------------
 
-    def prefill_budget(self, n_decoding: int, chunk_tokens: int) -> int:
+    def prefill_budget(self, n_decoding: int, chunk_tokens: int,
+                       decode_cost: int = 1) -> int:
         """Sarathi chunk budget for one step: the token budget left after
-        paying one token per active decode, capped at the engine's chunk
-        program width and floored at 1 so prefill always progresses even
-        when decodes alone exceed the budget."""
-        return max(1, min(chunk_tokens, self.token_budget - n_decoding))
+        paying ``decode_cost`` tokens per active decode, capped at the
+        engine's chunk program width and floored at 1 so prefill always
+        progresses even when decodes alone exceed the budget.
+        ``decode_cost`` is 1 for plain decode; a speculative engine
+        reserves ``spec_k + 1`` per decoding slot — the verify dispatch
+        scores that many positions whether or not they are accepted, so
+        the step's compute reservation must not be distorted by
+        speculation (WFQ SERVICE charging, by contrast, bills accepted
+        tokens only, through ``Request.uncharged_tokens``)."""
+        return max(1, min(chunk_tokens,
+                          self.token_budget - n_decoding * decode_cost))
 
     def schedule_step(self) -> List[Admission]:
         """Admit from the policy's queue into free slots until slots or

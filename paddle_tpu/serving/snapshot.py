@@ -53,8 +53,13 @@ from .scheduler import Request
 #: v3 (r12): requests carry ``tenant`` + fair-queueing charge marks, the
 #: scheduler section carries the policy's state (WFQ virtual counters
 #: survive a restart).  v2 snapshots still load — the new fields default.
-SNAPSHOT_VERSION = 3
-_READABLE_VERSIONS = (2, 3)
+#: v4 (r13): requests carry speculative-decoding counters
+#: (``spec_drafted`` / ``spec_accepted``).  Draft buffers themselves are
+#: deliberately NOT captured — the drafter is deterministic over request
+#: history, so a restored engine re-drafts and stays token-exact
+#: (tests/test_speculative.py).  Older snapshots load with zero counters.
+SNAPSHOT_VERSION = 4
+_READABLE_VERSIONS = (2, 3, 4)
 
 
 def _request_state(req: Request) -> dict:
@@ -69,7 +74,9 @@ def _request_state(req: Request) -> dict:
                 t_first_token=req.t_first_token,
                 t_last_token=req.t_last_token,
                 vt_charged=int(req.vt_charged),
-                max_prompt_prefilled=int(req.max_prompt_prefilled))
+                max_prompt_prefilled=int(req.max_prompt_prefilled),
+                spec_drafted=int(req.spec_drafted),
+                spec_accepted=int(req.spec_accepted))
 
 
 def _request_from_state(st: dict) -> Request:
@@ -85,6 +92,8 @@ def _request_from_state(st: dict) -> Request:
     req.t_last_token = st.get("t_last_token")
     req.vt_charged = int(st.get("vt_charged", 0))
     req.max_prompt_prefilled = int(st.get("max_prompt_prefilled", 0))
+    req.spec_drafted = int(st.get("spec_drafted", 0))
+    req.spec_accepted = int(st.get("spec_accepted", 0))
     return req
 
 

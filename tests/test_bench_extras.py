@@ -64,7 +64,7 @@ def test_int8_flagship_bench_config_runs():
 def test_decode_bench_emits_numbers():
     """bf16-vs-int8 decode bench on tiny shapes: both paths run, the
     argmax-match contract is reported, and tokens/sec are finite."""
-    res = bench._decode_bench(hidden=64, layers=2, heads=2, vocab=256,
+    res = bench._decode_bench(hidden=64, layers=1, heads=2, vocab=256,
                               batch=2, prompt=8, new_tokens=8,
                               dtype="float32")
     assert res["bf16"]["tokens_per_sec"] > 0
@@ -79,7 +79,7 @@ def test_serving_bench_smoke():
     satellite): the static baseline and the continuous-batching engine
     both complete the mixed load, every request gets a latency, and the
     report carries the throughput/latency fields the TPU run records."""
-    res = bench._serving_bench(hidden=48, layers=2, heads=2, vocab=128,
+    res = bench._serving_bench(hidden=48, layers=1, heads=2, vocab=128,
                                n_requests=5, max_slots=2, page_size=8,
                                prompt_len=8, new_tokens_max=12,
                                dtype="float32", decode_block=4)
@@ -105,7 +105,7 @@ def test_serving_bench_smoke():
 def test_serving_bench_poisson_arrivals():
     """The Poisson-arrival mode (arrival_rate set) also completes and
     latencies stay positive (completion can't precede arrival)."""
-    res = bench._serving_bench(hidden=48, layers=2, heads=2, vocab=128,
+    res = bench._serving_bench(hidden=48, layers=1, heads=2, vocab=128,
                                n_requests=4, max_slots=2, page_size=8,
                                prompt_len=8, new_tokens_max=8,
                                dtype="float32", decode_block=2,
@@ -119,7 +119,7 @@ def test_prefix_serving_bench_smoke():
     r09 satellite): both engine runs (prefix cache off and on) complete
     the same load, the cached run reports a NONZERO hit rate, and the
     no-cache run reports zero (the control is really a control)."""
-    res = bench._prefix_serving_bench(hidden=48, layers=2, heads=2,
+    res = bench._prefix_serving_bench(hidden=48, layers=1, heads=2,
                                       vocab=128, n_requests=4, max_slots=2,
                                       page_size=8, shared_len=16,
                                       unique_len=8, new_tokens=6,
@@ -147,7 +147,7 @@ def test_metrics_overhead_bench_smoke():
     The < 2% bar is asserted loosely here (CPU CI timing noise on a
     sub-second run dwarfs the real registry cost); bench.py records the
     honest number on quiet hardware."""
-    res = bench._metrics_overhead_bench(hidden=48, layers=2, heads=2,
+    res = bench._metrics_overhead_bench(hidden=48, layers=1, heads=2,
                                         vocab=128, n_requests=8,
                                         max_slots=2, page_size=8,
                                         prompt_len=8, new_tokens=12,
@@ -194,7 +194,7 @@ def test_overload_serving_bench_smoke():
     vs unbounded) complete, terminal accounting is total (completed +
     rejected + expired covers every request in the bounded run), and the
     unbounded control neither rejects nor expires."""
-    res = bench._overload_serving_bench(hidden=48, layers=2, heads=2,
+    res = bench._overload_serving_bench(hidden=48, layers=1, heads=2,
                                         vocab=128, n_requests=5,
                                         max_slots=2, page_size=8,
                                         prompt_len=8, new_tokens=8,
@@ -219,7 +219,7 @@ def test_slo_serving_bench_smoke():
     sum to ~1 where anything completed, and the weight-share targets are
     recorded.  The +/-10-point share bar lives in the slow TPU test —
     CPU timing noise at this size swamps real scheduling effects."""
-    res = bench._slo_serving_bench(hidden=48, layers=2, heads=2, vocab=128,
+    res = bench._slo_serving_bench(hidden=48, layers=1, heads=2, vocab=128,
                                    n_per_tenant=2, weights=(3.0, 1.0),
                                    max_slots=2, page_size=8, prompt_len=8,
                                    new_tokens=8, dtype="float32",
@@ -276,3 +276,47 @@ def test_overload_serving_bench_tpu_scale():
                                         overload_factor=3.0,
                                         decode_block=8)
     assert res["goodput_ratio_bounded_vs_capacity"] >= 0.9, res
+
+
+def test_spec_serving_bench_smoke():
+    """Fast CPU smoke of the speculative-decoding bench (ISSUE r13
+    satellite): both workload legs complete spec-off and spec-on with
+    identical budgets, the repetitive leg's acceptance is high (tiled
+    prompts are the prompt-lookup sweet spot), and the report carries
+    the throughput/acceptance fields the TPU run records."""
+    res = bench._spec_serving_bench(hidden=32, layers=1, heads=2,
+                                    vocab=128, n_requests=4, max_slots=2,
+                                    page_size=8, prompt_len=15,
+                                    new_tokens=12, dtype="float32",
+                                    spec_k=2)
+    for leg in ("repetitive", "mixed"):
+        for side in ("spec_off", "spec_on"):
+            assert res[leg][side]["tokens_per_sec"] > 0
+            assert res[leg][side]["decode_steps"] > 0
+        on = res[leg]["spec_on"]
+        assert 0.0 <= on["acceptance_rate"] <= 1.0
+        assert on["spec_drafted"] >= on["spec_rejected"] >= 0
+        # speculation advances >= 1 token per verify: never MORE decode
+        # steps than the plain engine on the identical load
+        assert on["decode_steps"] <= res[leg]["spec_off"]["decode_steps"]
+        assert np.isfinite(res[leg]["speedup"])
+    # tiled (period-5) prompts keep the n-gram lookup hitting
+    assert res["repetitive"]["spec_on"]["acceptance_rate"] >= 0.5
+    assert res["config"]["spec_k"] == 2
+
+
+@pytest.mark.slow
+def test_spec_serving_bench_tpu_scale():
+    """The flagship-sized speculative point bench.py records on TPU
+    (marked slow).  The r13 acceptance bar lives here: >= 1.3x decode
+    tokens/s/request spec-on vs spec-off on the repetitive-suffix leg,
+    at acceptance >= 0.5."""
+    res = bench._spec_serving_bench(hidden=1536, layers=24, heads=12,
+                                    vocab=50304, n_requests=32,
+                                    max_slots=8, page_size=64,
+                                    prompt_len=128, new_tokens=192,
+                                    dtype="bfloat16", spec_k=4)
+    rep = res["repetitive"]
+    assert rep["spec_on"]["acceptance_rate"] >= 0.5, res
+    assert rep["spec_on"]["tokens_per_sec_per_request"] >= \
+        1.3 * rep["spec_off"]["tokens_per_sec_per_request"], res

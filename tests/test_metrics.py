@@ -29,7 +29,9 @@ from paddle_tpu.serving import (TERMINAL_REASONS, MetricsFileExporter,
                                 TraceRecorder)
 from paddle_tpu.serving.metrics import Counter, Gauge, Histogram
 
-CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+# 1-layer model: these files assert scheduling/fault/metrics properties,
+# not KV layout — multi-layer paged-KV exactness lives in test_serving.py.
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
            max_seq_len=96, dropout=0.0)
 
 
@@ -627,7 +629,7 @@ def test_serving_runtime_modules_loaded_clean():
     (this file imported the package) — none of the forbidden client
     libraries may have come along for the ride."""
     for mod in ("metrics", "tracing", "kv_pool", "prefix_cache",
-                "scheduler", "engine", "faults", "snapshot"):
+                "scheduler", "engine", "faults", "snapshot", "drafter"):
         assert f"paddle_tpu.serving.{mod}" in sys.modules
     for banned in ("tensorboard", "prometheus_client", "opentelemetry",
                    "tensorboardX", "visualdl"):

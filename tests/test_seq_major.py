@@ -168,7 +168,7 @@ def test_pp2_pipeline_losses_match():
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed.fleet import meta_parallel as mpp
 
-    cfg_kw = dict(CFG, num_layers=4)
+    cfg_kw = dict(CFG, num_layers=2)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg_kw["vocab_size"], (8, 16)).astype("int32")
     labels = rng.randint(0, cfg_kw["vocab_size"], (8, 16)).astype("int64")
@@ -329,7 +329,9 @@ def test_ring_attention_accepts_sbnd_layout():
     kb = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
     vb = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
     qs, ks, vs = (jnp.transpose(a, (2, 0, 1, 3)) for a in (qb, kb, vb))
-    for causal in (False, True):
+    # causal only: layout acceptance is mask-independent, and the full
+    # (non-causal) ring parity is covered by test_ring_attention.py.
+    for causal in (True,):
         ref = ring_attention(qb, kb, vb, axis="mp", causal=causal,
                              use_flash=False)
         out = ring_attention(qs, ks, vs, axis="mp", causal=causal,

@@ -19,7 +19,9 @@ from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
 from paddle_tpu.serving import (TERMINAL_REASONS, FaultPlan, InjectedFault,
                                 ServingEngine)
 
-CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+# 1-layer model: these files assert scheduling/fault/metrics properties,
+# not KV layout — multi-layer paged-KV exactness lives in test_serving.py.
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
            max_seq_len=96, dropout=0.0)
 
 
@@ -328,3 +330,71 @@ def test_real_fault_mid_step_reparks_terminals(monkeypatch):
     assert out[r2].reason == "cancelled"   # the parked terminal survived
     assert out[r1].reason == "length" and len(out[r1].tokens) == 3
     assert eng.pool.pages_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding under chaos (r13)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("spec_k,seed", [
+    (0, 7), (2, 7), (0, 11), (2, 11),
+])
+def test_chaos_spec_terminal_totality_and_leak_freedom(spec_k, seed):
+    """r13 satellite: the chaos contract is speculation-agnostic — the
+    same seeded plans driven spec-off and spec-on (n-gram drafts +
+    multi-query verify, with the new "verify" phase in the plan's draw
+    space) still give exactly-one-terminal per request and a leak-free
+    drain, with check_invariants' draft-buffer audit live after every
+    step via the conftest fixture."""
+    model = _model()
+    plan = FaultPlan.random(seed, n_steps=30, p_alloc=0.20, p_raise=0.12,
+                            p_latency=0.15, max_latency_s=0.01,
+                            step_tick_s=1e-3)
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=8,
+                        chunk_tokens=8, max_queue=3, faults=plan,
+                        spec_k=spec_k)
+    rng = np.random.RandomState(200 + seed)
+    rids, terminals = _drive_chaos_load(
+        eng, rng, arrivals={2: None, 4: 0.01, 6: None, 8: None, 10: 0.02})
+    for fin in terminals.values():
+        assert fin.finish_reason in TERMINAL_REASONS
+    assert plan.injected["alloc_fail"] + plan.injected["raise"] > 0
+    assert eng.scheduler.n_active == 0 and eng.scheduler.n_waiting == 0
+    assert eng.pool.pages_in_use == 0
+    eng.pool.check()
+    eng.check_invariants()
+
+
+def test_injected_verify_fault_leaves_draft_state_consistent():
+    """A step fault injected MID-VERIFY — after drafts are proposed and
+    pages grown, before the verify dispatch — is absorbed: the drafter
+    is stateless over request history, so the engine simply re-drafts
+    next step and the drain stays token-for-token identical to a
+    fault-free speculative run.  Draft buffers remain within the
+    check_invariants bounds throughout (conftest audits every step)."""
+    model = _model()
+    rng = np.random.RandomState(31)
+    A = rng.randint(0, 512, (8,)).astype("int32")
+    B = rng.randint(0, 512, (16,)).astype("int32")
+
+    def run(plan):
+        eng = ServingEngine(model, max_slots=2, page_size=8,
+                            spec_k=2, faults=plan)
+        ra = eng.add_request(A, 12)
+        rb = eng.add_request(B, 10)
+        out = eng.run()
+        eng.check_invariants()
+        assert eng.pool.pages_in_use == 0
+        return [list(out[r].tokens) for r in (ra, rb)], eng
+
+    clean, _ = run(None)
+    plan = FaultPlan(raise_steps={3: "verify", 5: "verify", 7: "verify"})
+    faulty, eng = run(plan)
+    assert plan.injected["raise"] == 3
+    assert eng.stats["step_faults"] == 3
+    assert faulty == clean
+    # faulted steps dispatched nothing: the fault fired before verify
+    assert eng.stats["spec_drafted"] == \
+        eng.stats["spec_accepted"] + eng.stats["spec_rejected"]

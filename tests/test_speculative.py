@@ -1,0 +1,489 @@
+"""Speculative decoding inside continuous batching (ISSUE r13).
+
+Acceptance contracts, all CPU-runnable:
+
+  * the multi-query paged-attention verify kernel (interpret mode — the
+    exact TPU code path) matches its jnp reference EXACTLY over the
+    q_tile x dtype matrix, each mq row matches the single-query kernel
+    run sequentially at the same position, and the q_tile=1 wrapper
+    lowers to the EXISTING single-query kernel (jaxpr-level identity);
+  * speculative greedy decode (n-gram self-draft + one verify dispatch +
+    longest-agreeing-prefix acceptance) produces token-for-token the
+    dense greedy decoder's output on fp/int8 x jnp/kernel x
+    spec_k ∈ {2,4} x single-device/tp2 — including under preemption and
+    snapshot/restore, and with oracle (always-right) and adversarial
+    (always-wrong) drafters injected;
+  * the regression satellite: a slot whose remaining budget is smaller
+    than the fused/speculated step width never overshoots
+    max_new_tokens and never writes a page it doesn't own, with and
+    without speculation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels import paged_attention as pa
+from paddle_tpu.models.generation import build_generate_fn, spec_accept_greedy
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import NGramDrafter, ServingEngine
+from paddle_tpu.serving.drafter import NGramDrafter as _DirectDrafter
+
+# 1 transformer layer keeps every engine test here fast to trace; the
+# snapshot test overrides num_layers=2 so one spec run still exercises
+# the KV pool's layer dimension (test_serving.py covers L=2 broadly).
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
+           max_seq_len=96, dropout=0.0)
+
+
+def _model(seed=3, **over):
+    paddle.seed(seed)
+    m = GPTForPretraining(GPTConfig(**{**CFG, **over}))
+    m.eval()
+    return m
+
+
+_REF_CACHE = {}
+
+
+def _dense_greedy(model, prompts, n, int8=False, cache_key=None):
+    if cache_key is not None and cache_key in _REF_CACHE:
+        return _REF_CACHE[cache_key]
+    outs = []
+    for p in prompts:
+        fn = build_generate_fn(model, n, greedy=True, int8=int8)
+        outs.append(np.asarray(fn(p[None]))[0, len(p):])
+    if cache_key is not None:
+        _REF_CACHE[cache_key] = outs
+    return outs
+
+
+class OracleDrafter:
+    """Always-right drafter: proposes the dense reference continuation,
+    so every draft position accepts (the full-accept path, pinned
+    deterministically — no reliance on greedy cycles)."""
+
+    def __init__(self, spec_k, continuations):
+        self.spec_k = spec_k
+        # {prompt prefix tuple -> full continuation list}
+        self._conts = continuations
+
+    def draft(self, history, max_tokens=None):
+        k = self.spec_k if max_tokens is None else min(self.spec_k,
+                                                       int(max_tokens))
+        h = [int(t) for t in history]
+        for plen, cont in self._conts:
+            if h[:plen] == cont["prompt"] and len(h) >= plen:
+                done = h[plen:]
+                if done == cont["tokens"][:len(done)]:
+                    nxt = cont["tokens"][len(done):len(done) + k]
+                    return np.asarray(nxt, np.int32)
+        return np.zeros((0,), np.int32)
+
+
+class AdversarialDrafter:
+    """Always-wrong drafter: proposes a vocab-edge token greedy decode
+    essentially never picks, so every draft rejects — speculation must
+    degrade to plain one-token decode, never corrupt output."""
+
+    def __init__(self, spec_k):
+        self.spec_k = spec_k
+
+    def draft(self, history, max_tokens=None):
+        k = self.spec_k if max_tokens is None else min(self.spec_k,
+                                                       int(max_tokens))
+        return np.full((max(k, 0),), 511, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# the drafter
+# ---------------------------------------------------------------------------
+
+
+def test_drafter_prompt_lookup_basics():
+    d = NGramDrafter(4, max_ngram=3)
+    # trailing [2,3,4] occurred earlier; continuation after the match
+    np.testing.assert_array_equal(
+        d.draft([1, 2, 3, 4, 9, 2, 3, 4]), [9, 2, 3, 4])
+    # no earlier occurrence at any n: nothing proposed
+    assert d.draft([1, 2, 3, 4, 5, 6]).size == 0
+    # empty / tiny histories are safe
+    assert d.draft([]).size == 0
+    assert d.draft([7]).size == 0
+
+
+def test_drafter_longest_ngram_and_recency_win():
+    d = NGramDrafter(2, max_ngram=3)
+    # trailing [5,6,7]: the 3-gram match (-> 8) must beat any shorter one
+    np.testing.assert_array_equal(
+        d.draft([5, 6, 7, 8, 0, 7, 1, 5, 6, 7]), [8, 0])
+    # two occurrences of the trailing 1-gram: the MOST RECENT wins
+    d1 = NGramDrafter(1, max_ngram=1)
+    np.testing.assert_array_equal(d1.draft([4, 1, 4, 2, 4]), [2])
+
+
+def test_drafter_max_tokens_caps_proposal():
+    d = NGramDrafter(4, max_ngram=2)
+    out = d.draft([1, 2, 3, 4, 1, 2], max_tokens=2)
+    np.testing.assert_array_equal(out, [3, 4])
+    assert d.draft([1, 2, 3, 1, 2], max_tokens=0).size == 0
+
+
+def test_drafter_validation_and_export():
+    with pytest.raises(ValueError):
+        NGramDrafter(0)
+    with pytest.raises(ValueError):
+        NGramDrafter(2, max_ngram=1, min_ngram=2)
+    assert NGramDrafter is _DirectDrafter  # package export is the module
+
+
+def test_spec_accept_greedy_rule():
+    # full agreement: all drafts + the bonus token
+    assert spec_accept_greedy(np.asarray([5, 6, 7]), [5, 6]) == (2, [5, 6, 7])
+    # first disagreement truncates: correction replaces the bad draft
+    assert spec_accept_greedy(np.asarray([5, 9, 7]), [5, 6]) == (1, [5, 9])
+    assert spec_accept_greedy(np.asarray([4, 6, 7]), [5, 6]) == (0, [4])
+    # empty draft = plain decode
+    assert spec_accept_greedy(np.asarray([3]), []) == (0, [3])
+
+
+# ---------------------------------------------------------------------------
+# the multi-query verify kernel
+# ---------------------------------------------------------------------------
+
+
+def _mq_fixture(rng, B=3, H=2, D=128, PS=32, NP=12, MAXP=4, T=3, int8=False):
+    kf = rng.randn(NP, H, PS, D).astype("float32")
+    vf = rng.randn(NP, H, PS, D).astype("float32")
+    bt = jnp.asarray(rng.randint(1, NP, (B, MAXP)), jnp.int32)
+    lens = jnp.asarray(rng.randint(1, PS * MAXP - T, (B,)), jnp.int32)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype("float32"))
+    if int8:
+        from paddle_tpu.ops.quant_ops import quantize_per_token
+
+        kq, ks = quantize_per_token(jnp.asarray(kf))
+        vq, vs = quantize_per_token(jnp.asarray(vf))
+        return q, kq, vq, bt, lens, dict(k_scales=ks, v_scales=vs)
+    return q, jnp.asarray(kf), jnp.asarray(vf), bt, lens, {}
+
+
+@pytest.mark.parametrize("q_tile", [1, 2, 4])
+@pytest.mark.parametrize("int8", [False, True])
+def test_mq_kernel_matches_ref_matrix(q_tile, int8):
+    """The r13 parity matrix: q_tile x {fp,int8} x {jnp ref, interpret
+    kernel} agree exactly (same mask and dequant decisions)."""
+    rng = np.random.RandomState(10 * q_tile + int8)
+    q, kp, vp, bt, lens, kw = _mq_fixture(rng, T=q_tile, int8=int8)
+    ref = pa.paged_attention_mq_ref(q, kp, vp, bt, lens, **kw)
+    out = pa.paged_attention_mq(q, kp, vp, bt, lens, interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mq_rows_match_sequential_single_query():
+    """Causal semantics cross-check: row t of one mq dispatch equals the
+    single-query kernel with the length advanced to that row's position
+    (the query at L+t attends to pages 0..L+t inclusive)."""
+    rng = np.random.RandomState(3)
+    q, kp, vp, bt, lens, _ = _mq_fixture(rng, T=3)
+    out = pa.paged_attention_mq_ref(q, kp, vp, bt, lens)
+    for t in range(3):
+        row = pa.paged_attention_ref(q[:, t], kp, vp, bt, lens + t + 1)
+        np.testing.assert_allclose(np.asarray(out[:, t]), np.asarray(row),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_mq_q_tile_1_lowers_to_single_query_kernel():
+    """q_tile=1 is DEFINED as the existing decode kernel: the mq entry
+    dispatches to ``paged_attention`` with lengths+1 (the mask j <= L is
+    j < L+1), asserted at the jaxpr level so the identity can't drift
+    into a separately-maintained code path."""
+    rng = np.random.RandomState(4)
+    q, kp, vp, bt, lens, _ = _mq_fixture(rng, T=1)
+
+    def mq(q, kp, vp, bt, lens):
+        return pa.paged_attention_mq(q, kp, vp, bt, lens, interpret=True)
+
+    def sq(q, kp, vp, bt, lens):
+        return pa.paged_attention(q[:, 0], kp, vp, bt, lens + 1,
+                                  interpret=True)[:, None]
+
+    jx_mq = jax.make_jaxpr(mq)(q, kp, vp, bt, lens)
+    jx_sq = jax.make_jaxpr(sq)(q, kp, vp, bt, lens)
+    assert str(jx_mq) == str(jx_sq)
+    np.testing.assert_array_equal(np.asarray(mq(q, kp, vp, bt, lens)),
+                                  np.asarray(sq(q, kp, vp, bt, lens)))
+
+
+def test_mq_supported_gate():
+    assert pa.supported_mq(2, 32, 128, 5)       # test-sized: fits
+    assert not pa.supported_mq(2, 32, 100, 5)   # head_dim % 128
+    assert not pa.supported_mq(2, 30, 128, 5)   # page_size % 32
+    assert not pa.supported_mq(64, 512, 512, 8)  # VMEM blowout
+
+
+# ---------------------------------------------------------------------------
+# engine: speculative greedy == dense greedy, exactly
+# ---------------------------------------------------------------------------
+
+
+def _spec_engine_run(model, prompts, news, int8=False, kernel=False,
+                     spec_k=2, drafter=None, **kw):
+    eng = ServingEngine(model, max_slots=2, num_pages=24, page_size=8,
+                        int8=int8, use_paged_kernel=kernel,
+                        spec_k=spec_k, drafter=drafter, **kw)
+    rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+    out = eng.run()
+    eng.check_invariants()
+    assert eng.pool.pages_in_use == 0
+    return [np.asarray(out[r].tokens) for r in rids], eng
+
+
+@pytest.mark.parametrize("mode,spec_k", [
+    # pairwise-covering slice of fp/int8 x jnp/kernel x spec_k {2,4}:
+    # every dtype meets every dispatch path and every spec_k meets both.
+    ("fp_jnp", 2), ("fp_kernel", 4), ("int8_jnp", 4), ("int8_kernel", 2),
+])
+def test_engine_spec_matches_dense_greedy(mode, spec_k):
+    """The r13 acceptance contract: speculative greedy decode ==
+    non-speculative dense greedy, token for token, across fp/int8 x
+    jnp/kernel x spec_k — with NONZERO acceptance (random tiny-model
+    greedy falls into repetition cycles the n-gram drafter recovers)."""
+    int8 = "int8" in mode
+    model = _model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 500, (8,)).astype("int32"),
+               rng.randint(0, 500, (16,)).astype("int32")]
+    news = [16, 12]
+    refs = _dense_greedy(model, prompts, 16, int8=int8,
+                         cache_key=f"r13_{int8}")
+    toks, eng = _spec_engine_run(model, prompts, news, int8=int8,
+                                 kernel="kernel" in mode, spec_k=spec_k)
+    for got, ref, n in zip(toks, refs, news):
+        np.testing.assert_array_equal(got, ref[:n])
+    assert eng.stats["spec_accepted"] > 0
+    assert eng.stats["spec_drafted"] == \
+        eng.stats["spec_accepted"] + eng.stats["spec_rejected"]
+    # the verify program is ONE reused trace (continuous batching intact)
+    assert eng.stats["decode_traces"] == 1
+
+
+def test_engine_spec_oracle_and_adversarial_drafters():
+    """Injected drafters pin both extremes deterministically: an oracle
+    (always proposes the true continuation) accepts EVERY draft and
+    finishes in ~new/(k+1) verify calls; an adversary (always wrong)
+    rejects every draft and degrades to one-token steps — output is
+    exact either way (the verify pass, not the drafter, decides)."""
+    model = _model()
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(0, 500, (8,)).astype("int32"),
+               rng.randint(0, 500, (12,)).astype("int32")]
+    news = [12, 8]
+    refs = [np.asarray(r)
+            for r in _dense_greedy(model, prompts, 12)]
+    conts = [(len(p), {"prompt": [int(t) for t in p],
+                       "tokens": [int(t) for t in r[:n]]})
+             for p, r, n in zip(prompts, refs, news)]
+
+    toks, eng = _spec_engine_run(model, prompts, news, spec_k=3,
+                                 drafter=OracleDrafter(3, conts))
+    for got, ref, n in zip(toks, refs, news):
+        np.testing.assert_array_equal(got, ref[:n])
+    assert eng.stats["spec_rejected"] == 0
+    assert eng.stats["spec_accepted"] == eng.stats["spec_drafted"] > 0
+    # full acceptance advances k+1 tokens per verify: 12 new in <= 3
+    # resident verify steps for the first request (vs 12 plain steps)
+    assert eng.stats["decode_calls"] <= 8
+
+    toks, eng = _spec_engine_run(model, prompts, news, spec_k=3,
+                                 drafter=AdversarialDrafter(3))
+    for got, ref, n in zip(toks, refs, news):
+        np.testing.assert_array_equal(got, ref[:n])
+    assert eng.stats["spec_accepted"] == 0
+    assert eng.stats["spec_rejected"] == eng.stats["spec_drafted"] > 0
+
+
+def test_engine_spec_preempt_recompute_exact():
+    """Speculation x preemption (the r10 proof shape): a pool too small
+    for both residents forces preemption mid-speculation; the victim
+    recomputes through chunked prefill and every request still produces
+    exactly the dense greedy tokens.  Draft buffers never survive the
+    eviction (check_invariants audits them every step via conftest)."""
+    model = _model()
+    rng = np.random.RandomState(51)
+    A = rng.randint(0, 512, (8,)).astype("int32")
+    B = rng.randint(0, 512, (16,)).astype("int32")
+    refA = _dense_greedy(model, [A], 24)[0]
+    refB = _dense_greedy(model, [B], 16)[0]
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=7,
+                        chunk_tokens=16, spec_k=2)
+    ra = eng.add_request(A, 24)
+    rb = eng.add_request(B, 16)
+    out = eng.run()
+    assert eng.stats["preemptions"] >= 1
+    assert eng.stats["recompute_tokens"] > 0
+    np.testing.assert_array_equal(out[ra].tokens, refA)
+    np.testing.assert_array_equal(out[rb].tokens, refB)
+    assert out[ra].reason == "length" and out[rb].reason == "length"
+    assert eng.pool.pages_in_use == 0
+
+
+def test_engine_spec_snapshot_restore_exact():
+    """Snapshot/restore with speculation ON: draft state is host-only
+    and reconstructible, so a v4 snapshot taken mid-speculation restores
+    to token-for-token identical output — and the per-request spec
+    counters survive the round trip."""
+    from paddle_tpu.serving import restore_engine, snapshot_engine
+
+    model = _model(num_layers=2)
+    rng = np.random.RandomState(57)
+    prompts = [rng.randint(0, 512, (n,)).astype("int32")
+               for n in (5, 19, 7)]
+    refs = _dense_greedy(model, prompts, 10, cache_key="r13_snap10")
+    eng = ServingEngine(model, max_slots=2, page_size=8, chunk_tokens=4,
+                        token_budget=8, spec_k=2)
+    rids = [eng.add_request(p, 10) for p in prompts]
+    done_pre = {}
+    for _ in range(4):
+        for f in eng.step():
+            done_pre[f.rid] = f
+    snap = snapshot_engine(eng)
+    assert snap["version"] == 4
+    assert snap["config"]["spec_k"] == 2
+    # draft buffers are never captured (host-only, reconstructible)
+    for s in snap["slots"]:
+        assert s is None or "draft" not in s
+    done_a = dict(done_pre)
+    done_a.update(eng.run())
+    eng2 = restore_engine(_model(num_layers=2), snap)
+    assert eng2.spec_k == 2
+    done_b = dict(done_pre)
+    done_b.update(eng2.run())
+    assert set(done_b) == set(rids)
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(done_b[rid].tokens, refs[i])
+        np.testing.assert_array_equal(done_b[rid].tokens,
+                                      done_a[rid].tokens)
+    # lifetime spec accounting carried over and kept growing
+    assert eng2.stats["spec_drafted"] >= snap["engine"]["stats"]["spec_drafted"]
+    assert eng2.pool.pages_in_use == 0
+
+
+def test_engine_spec_tp2_matches_single_device():
+    """tp2 speculative decode (mp=2 mesh, GSPMD global arrays) ==
+    single-device dense greedy: the verify program shards like the
+    decode program it generalizes."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    single = _model(seed=0)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 512, (5,)).astype("int32"),
+               rng.randint(0, 512, (9,)).astype("int32")]
+    refs = _dense_greedy(single, prompts, 8, cache_key="r13_tp2_8")
+
+    mesh_mod.build_hybrid_mesh(dp=1, mp=2, pp=1, sharding=1)
+    paddle.seed(0)
+    tp = GPTForPretraining(GPTConfig(**CFG, use_parallel=True))
+    tp.eval()
+    eng = ServingEngine(tp, max_slots=2, page_size=8,
+                        use_paged_kernel=False, spec_k=2)
+    rids = [eng.add_request(p, 8) for p in prompts]
+    out = eng.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(out[rid].tokens, refs[i])
+    assert eng.stats["spec_accepted"] > 0
+
+
+def test_engine_spec_requires_greedy_and_no_decode_block():
+    model = _model()
+    with pytest.raises(ValueError, match="greedy"):
+        ServingEngine(model, spec_k=2, greedy=False, top_p=0.9)
+    with pytest.raises(ValueError, match="decode_block"):
+        ServingEngine(model, spec_k=2, decode_block=4)
+    with pytest.raises(ValueError):
+        NGramDrafter(0)
+
+
+# ---------------------------------------------------------------------------
+# regression satellite: fused/speculated steps near max_new_tokens
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["block4", "spec4"])
+def test_engine_step_width_never_overshoots_budget(mode):
+    """A slot with remaining_new < the step width (fused decode_block=4
+    or spec_k=4 drafts) must emit EXACTLY max_new_tokens — never
+    overshoot the budget — and never write a page it doesn't own:
+    every page the run ever references is tracked, and pages outside
+    that set (minus the null page) still hold their zero-initialized
+    contents at drain."""
+    model = _model()
+    rng = np.random.RandomState(77)
+    # max_new NOT a multiple of the width, and smaller than it for one
+    prompts = [rng.randint(0, 512, (6,)).astype("int32"),
+               rng.randint(0, 512, (9,)).astype("int32")]
+    news = [3, 7]
+    refs = _dense_greedy(model, prompts, 7, cache_key="r13_width7")
+    kw = (dict(decode_block=4) if mode == "block4"
+          else dict(spec_k=4))
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=20,
+                        prefix_cache=False, **kw)
+    # record every page the pool ever hands out (robust against pages
+    # allocated and freed within one step — e.g. a request finishing the
+    # same step its last page was grown)
+    used, orig_alloc = set(), eng.pool.alloc
+
+    def recording_alloc(n_pages):
+        pages = orig_alloc(n_pages)
+        if pages:
+            used.update(pages)
+        return pages
+
+    eng.pool.alloc = recording_alloc
+    rids = [eng.add_request(p, n) for p, n in zip(prompts, news)]
+    done = eng.run()
+    for rid, ref, n in zip(rids, refs, news):
+        assert len(done[rid].tokens) == n          # exact budget, no more
+        np.testing.assert_array_equal(done[rid].tokens, ref[:n])
+        assert done[rid].reason == "length"
+    # pages the run never owned were never written (null page 0 excluded)
+    untouched = set(range(eng.pool.num_pages)) - used - {0}
+    assert untouched, "pool too small to prove anything"
+    k_buf = np.asarray(eng.pool.buffers["k"])
+    v_buf = np.asarray(eng.pool.buffers["v"])
+    idx = sorted(untouched)
+    assert not np.any(k_buf[:, idx]) and not np.any(v_buf[:, idx])
+    assert eng.pool.pages_in_use == 0
+
+
+def test_engine_spec_near_budget_caps_draft_length():
+    """White-box leg of the same satellite: with remaining_new = 1 the
+    drafter must not be consulted for more than 0 tokens (accept-all
+    plus the bonus token would otherwise overshoot), so the last step of
+    every request is a plain one-token verify."""
+    seen = []
+
+    class RecordingDrafter:
+        def draft(self, history, max_tokens=None):
+            seen.append(int(max_tokens))
+            k = min(4, int(max_tokens))
+            return np.full((max(k, 0),), 7, np.int32)
+
+    model = _model()
+    rng = np.random.RandomState(5)
+    p = rng.randint(0, 512, (6,)).astype("int32")
+    eng = ServingEngine(model, max_slots=1, page_size=8, spec_k=4,
+                        drafter=RecordingDrafter())
+    rid = eng.add_request(p, 6)
+    out = eng.run()
+    ref = _dense_greedy(model, [p], 6)[0]
+    assert len(out[rid].tokens) == 6
+    np.testing.assert_array_equal(out[rid].tokens, ref)
+    # every consult was capped at min(spec_k, remaining_new - 1) and the
+    # drafter is NEVER consulted once remaining_new == 1 (cap 0): an
+    # accept-all step of cap+1 tokens can exactly meet but not overshoot
+    assert seen and max(seen) <= 4 and min(seen) >= 1
+    assert 1 in seen or eng.stats["spec_accepted"] > 0

@@ -18,7 +18,9 @@ from paddle_tpu.serving import (FCFSPolicy, KVPool, Request, ServingEngine,
                                 TenantConfig, WFQPolicy)
 from paddle_tpu.serving.tenancy import make_policy
 
-CFG = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+# 1-layer model: these files assert scheduling/fault/metrics properties,
+# not KV layout — multi-layer paged-KV exactness lives in test_serving.py.
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
            max_seq_len=96, dropout=0.0)
 
 
@@ -273,6 +275,44 @@ def test_engine_wfq_preempted_request_keeps_virtual_counter():
     assert vt["b"] == pytest.approx((16 + 16) / 1.0)
 
 
+def test_wfq_spec_charges_accepted_only():
+    """r13 satellite: with speculation on, WFQ bills ACCEPTED tokens only
+    — rejected draft positions cost compute but never touch a tenant's
+    virtual counter.  At drain each tenant's counter equals exactly
+    (prompt + generated) / weight, the same invariant as the r12
+    preempt-no-double-charge test, while the run provably rejected
+    drafts (``stats["spec_rejected"] > 0`` via an adversarial drafter
+    that always proposes wrong tokens for one leg of the load)."""
+
+    class HalfWrongDrafter:
+        """Oracle-free adversarial drafter: always proposes vocab-edge
+        tokens a random-weights greedy decode essentially never picks —
+        every draft rejects, so spec_rejected grows with every step."""
+
+        def draft(self, history, max_tokens=None):
+            k = 2 if max_tokens is None else min(2, int(max_tokens))
+            return np.full((max(k, 0),), 511, np.int32)
+
+    model = _model()
+    rng = np.random.RandomState(60)
+    A = rng.randint(0, 500, (8,)).astype("int32")
+    B = rng.randint(0, 500, (16,)).astype("int32")
+    eng = ServingEngine(model, max_slots=2, page_size=8, policy="wfq",
+                        tenants={"a": 2.0, "b": 1.0}, spec_k=2,
+                        drafter=HalfWrongDrafter())
+    ra = eng.add_request(A, 24, tenant="a")
+    rb = eng.add_request(B, 16, tenant="b")
+    out = eng.run()
+    assert out[ra].reason == "length" and out[rb].reason == "length"
+    assert eng.stats["spec_rejected"] > 0
+    assert eng.stats["spec_drafted"] == \
+        eng.stats["spec_accepted"] + eng.stats["spec_rejected"]
+    vt = eng.scheduler.policy.vt
+    # served = prompt + generated, with NO term for rejected drafts
+    assert vt["a"] == pytest.approx((8 + 24) / 2.0)
+    assert vt["b"] == pytest.approx((16 + 16) / 1.0)
+
+
 def test_engine_wfq_greedy_tokens_match_fcfs():
     """Fairness reorders ADMISSION, not math: the same request set
     produces token-for-token identical greedy outputs under FCFS and
@@ -314,9 +354,9 @@ def test_engine_tenant_max_waiting_rejects_explicitly():
 
 
 def test_engine_wfq_snapshot_restores_virtual_counters():
-    """WFQ counters + tenant configs survive snapshot/restore (SNAPSHOT
-    v3): the fairness ledger carries across a restart and the resumed
-    run completes every request."""
+    """WFQ counters + tenant configs survive snapshot/restore: the
+    fairness ledger carries across a restart and the resumed run
+    completes every request."""
     from paddle_tpu.serving.snapshot import SNAPSHOT_VERSION
 
     model = _model()
@@ -333,7 +373,7 @@ def test_engine_wfq_snapshot_restores_virtual_counters():
     vt_before = dict(eng.scheduler.policy.vt)
     assert any(v > 0 for v in vt_before.values())
     snap = eng.snapshot()
-    assert snap["version"] == SNAPSHOT_VERSION == 3
+    assert snap["version"] == SNAPSHOT_VERSION == 4
     assert snap["scheduler"]["policy"]["name"] == "wfq"
 
     eng2 = ServingEngine.restore(model, snap)

@@ -16,7 +16,7 @@ from paddle_tpu.distributed import mesh as mesh_mod
 from paddle_tpu.models import GPTForPretraining
 from paddle_tpu.models.gpt import GPTConfig, build_functional_train_step
 
-CFG = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+CFG = dict(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
            max_seq_len=32, dropout=0.0)
 
 
