@@ -196,6 +196,14 @@ def main():
             hidden=1536, layers=24, heads=12, vocab=50304, n_requests=32,
             max_slots=8, page_size=64, prompt_len=128, new_tokens=192,
             dtype="bfloat16", spec_k=4)
+        # KV capacity: GQA + sliding window + int4 pages at a FIXED pool
+        # byte budget (ISSUE r14 acceptance: gqa_int4 serves >= 2x the
+        # concurrent slots of mha at equal bytes, preemptions and
+        # recompute_tokens no higher)
+        serving_kv_capacity = _kv_capacity_bench(
+            hidden=1536, layers=24, heads=12, vocab=50304, n_requests=32,
+            max_slots=16, page_size=64, prompt_len=96, new_tokens=96,
+            dtype="bfloat16", kv_group=4, window=64, decode_block=8)
         resnet = _resnet50_bench()
         bert = _bert_bench()
         head = flagship
@@ -241,6 +249,10 @@ def main():
             hidden=64, layers=2, heads=2, vocab=256, n_requests=6,
             max_slots=2, page_size=8, prompt_len=16, new_tokens=16,
             dtype="float32", spec_k=2)
+        serving_kv_capacity = _kv_capacity_bench(
+            hidden=64, layers=2, heads=4, vocab=256, n_requests=8,
+            max_slots=8, page_size=8, prompt_len=12, new_tokens=12,
+            dtype="float32", kv_group=4, window=8, decode_block=2)
         small = None
 
     out = {
@@ -265,6 +277,7 @@ def main():
     out["extra"]["serving_overload"] = serving_overload
     out["extra"]["serving_slo"] = serving_slo
     out["extra"]["serving_spec"] = serving_spec
+    out["extra"]["serving_kv_capacity"] = serving_kv_capacity
     # r11 acceptance guard: feeding the metrics registry + tracer every
     # step must not move engine goodput (CPU-sized on purpose — python
     # host-loop overhead is what it measures)
@@ -1016,6 +1029,133 @@ def _spec_serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
                      "prompt_len": prompt_len, "new_tokens": new_tokens,
                      "dtype": dtype, "spec_k": spec_k}
     return out
+
+
+def _kv_capacity_bench(hidden=1536, layers=24, heads=12, vocab=50304,
+                       n_requests=32, max_slots=16, page_size=64,
+                       prompt_len=96, new_tokens=96, dtype="bfloat16",
+                       kv_group=4, window=None, pool_tokens=None,
+                       decode_block=8, seed=0):
+    """KV capacity multiplication at a FIXED HBM byte budget (r14).
+
+    Four engines serve the SAME burst load from page pools holding the
+    SAME number of BYTES — sized so the MHA/full-precision baseline fits
+    ``pool_tokens`` (default 2.5x one request) worth of KV:
+
+      * ``mha``        — every query head stores its own K/V (baseline);
+      * ``gqa``        — ``heads // kv_group`` KV heads (grouped-query
+        attention): ``kv_group`` x more token positions per byte;
+      * ``gqa_window`` — GQA + sliding-window attention: a slot's live
+        pages stop growing at the window, recycled pages re-enter the
+        pool mid-request;
+      * ``gqa_int4``   — GQA + int4 KV pages (two nibbles per byte +
+        per-token scales): ~4x fewer bytes/token than bf16 on top of GQA.
+
+    At fixed bytes, more tokens per byte = more CONCURRENT slots before
+    the allocator pushes back, so preemptions and recompute_tokens fall
+    while goodput holds or rises.  Acceptance (r14): ``gqa_int4`` peak
+    concurrency >= 2x ``mha`` at equal pool bytes with preemptions and
+    recompute_tokens no higher, and every leg reports its measured
+    ``kv_bytes_per_token`` in the BENCH json.
+    """
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    if window is None:
+        window = max(2 * page_size, prompt_len // 2)
+    kv_heads = max(1, heads // kv_group)
+
+    def build_model(n_kv):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=prompt_len + new_tokens, dropout=0.0,
+                        num_kv_heads=(None if n_kv == heads else n_kv))
+        model = GPTForPretraining(cfg)
+        model.eval()
+        if dtype == "bfloat16":
+            for p in model.parameters():
+                p._array = p._array.astype(jnp.bfloat16)
+        return model
+
+    models = {n_kv: build_model(n_kv) for n_kv in {heads, kv_heads}}
+
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(0, vocab, (n_requests, prompt_len)).astype("int32")
+    useful = n_requests * new_tokens
+
+    def bytes_per_token(model, **kv_kw):
+        # a 2-page probe engine resolves the exact pool layout (kv heads,
+        # page dtype, packing) the real engine would build — the measured
+        # denominator, not a hand-derived formula
+        probe = ServingEngine(model, max_slots=1, page_size=page_size,
+                              num_pages=2, prefix_cache=False, **kv_kw)
+        return probe.pool.bytes_per_token()
+
+    budget = (pool_tokens or int(2.5 * (prompt_len + new_tokens))) \
+        * bytes_per_token(models[heads])
+
+    def leg(model, **kv_kw):
+        bpt = bytes_per_token(model, **kv_kw)
+        n_pages = 1 + max(1, int(budget // (bpt * page_size)))
+        eng = ServingEngine(model, max_slots=max_slots,
+                            page_size=page_size, num_pages=n_pages,
+                            greedy=True, decode_block=decode_block,
+                            prefix_cache=False, **kv_kw)
+        eng.add_request(prompts[0], 2)   # compile prefill + decode
+        eng.run()
+        _reset_mirrored_stats(eng)
+        eng.attach_metrics()
+        for p in prompts:
+            eng.add_request(p, int(new_tokens))
+        peak, conc_sum, steps = 0, 0, 0
+        t0 = time.perf_counter()
+        while eng.has_work:
+            eng.step()
+            occ = sum(1 for s in eng._slots if s is not None)
+            peak = max(peak, occ)
+            conc_sum += occ
+            steps += 1
+        wall = time.perf_counter() - t0
+        return {
+            "goodput_tokens_per_sec": round(useful / wall, 1),
+            "makespan_s": round(wall, 3),
+            "peak_concurrent_slots": peak,
+            "mean_concurrent_slots": round(conc_sum / max(steps, 1), 2),
+            "preemptions": eng.stats["preemptions"],
+            "recompute_tokens": eng.stats["recompute_tokens"],
+            "alloc_failures": eng.pool.alloc_failures,
+            "kv_bytes_per_token": bpt,
+            "pool_pages": n_pages,
+            "metrics": _registry_dict(eng.metrics),
+        }
+
+    legs = {
+        "mha": leg(models[heads]),
+        "gqa": leg(models[kv_heads]),
+        "gqa_window": leg(models[kv_heads], attn_window=window),
+        "gqa_int4": leg(models[kv_heads], kv_bits=4),
+    }
+    return {
+        **legs,
+        "capacity_multiplier_gqa_int4_vs_mha": round(
+            legs["mha"]["kv_bytes_per_token"]
+            / legs["gqa_int4"]["kv_bytes_per_token"], 2),
+        "concurrency_ratio_gqa_int4_vs_mha": round(
+            legs["gqa_int4"]["peak_concurrent_slots"]
+            / max(legs["mha"]["peak_concurrent_slots"], 1), 2),
+        "config": {"hidden": hidden, "layers": layers, "heads": heads,
+                   "kv_heads": kv_heads, "vocab": vocab,
+                   "n_requests": n_requests, "max_slots": max_slots,
+                   "page_size": page_size, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "dtype": dtype,
+                   "kv_group": kv_group, "window": window,
+                   "pool_budget_bytes": int(budget),
+                   "decode_block": decode_block,
+                   "useful_tokens": useful},
+    }
 
 
 def _metrics_overhead_bench(hidden=64, layers=2, heads=2, vocab=256,

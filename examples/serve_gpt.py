@@ -30,6 +30,11 @@ CPU-runnable out of the box (tiny config); flags scale it up::
     python examples/serve_gpt.py --speculate 4
         # r13: n-gram self-draft + multi-query verify; the summary line
         # reports drafted/accepted/rejected and the acceptance rate
+    python examples/serve_gpt.py --kv-heads 2 --window 64 --kv-bits 4
+        # r14: multiply KV capacity — grouped-query KV (2 of --heads
+        # heads stored), sliding-window attention with mid-request page
+        # recycling, and nibble-packed int4 pages; the engine banner
+        # prints bytes/token so the capacity win is visible
     python examples/serve_gpt.py --http 8000 --tenants a:3,b:1
         # r12: streaming HTTP front end (SSE /v1/completions, /metrics,
         # /healthz) with weighted-fair multi-tenant scheduling:
@@ -71,6 +76,18 @@ def main():
                          "request (shows the prefix cache working)")
     ap.add_argument("--int8", action="store_true",
                     help="serve W8A8 projections + int8 KV pages")
+    ap.add_argument("--kv-heads", type=int, default=None, metavar="N",
+                    help="grouped-query attention: store only N KV heads "
+                         "(must divide --heads); decode output stays "
+                         "token-identical to full MHA weights (r14)")
+    ap.add_argument("--window", type=int, default=None, metavar="W",
+                    help="sliding-window attention: each position attends "
+                         "to the last W keys and the engine RECYCLES "
+                         "pages behind the window mid-request (r14)")
+    ap.add_argument("--kv-bits", type=int, default=None, choices=[4, 8],
+                    help="quantize KV pages to 4 (nibble-packed) or 8 "
+                         "bits with per-position fp32 scales; 4-bit "
+                         "pages hold ~8x the tokens of fp32 (r14)")
     ap.add_argument("--top-p", type=float, default=1.0,
                     help="< 1.0 switches greedy off and nucleus-samples")
     ap.add_argument("--eos", type=int, default=None,
@@ -108,7 +125,8 @@ def main():
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
                     num_layers=args.layers, num_heads=args.heads,
-                    max_seq_len=args.max_seq, dropout=0.0)
+                    max_seq_len=args.max_seq, dropout=0.0,
+                    num_kv_heads=args.kv_heads, attn_window=args.window)
     model = GPTForPretraining(cfg)
     model.eval()
 
@@ -129,6 +147,7 @@ def main():
                         eos_token_id=args.eos, int8=args.int8,
                         max_queue=args.max_queue, faults=faults,
                         tenants=tenants, spec_k=args.speculate,
+                        kv_bits=args.kv_bits,
                         metrics=args.metrics_dir is not None,
                         trace=args.metrics_dir is not None)
     if args.http is not None:
@@ -166,6 +185,9 @@ def main():
     print(f"engine: slots={args.slots} page_size={args.page_size} "
           f"pool={eng.pool.num_pages} pages "
           f"({eng.pool.hbm_bytes() / 1e6:.1f} MB) int8={args.int8}")
+    print(f"kv layout: {eng.pool.num_kv_heads}/{args.heads} kv heads, "
+          f"kv_bits={eng.kv_bits or '-'} window={eng.window or '-'} -> "
+          f"{eng.pool.bytes_per_token()} KV bytes/token")
 
     rng = np.random.RandomState(0)
     system = rng.randint(0, args.vocab, (args.shared_prefix,))

@@ -43,6 +43,17 @@ def main():
                    help="W8A8 int8 projections (GPTConfig.int8): real "
                         "int8 GEMMs with dynamic per-token activation "
                         "quant and an STE backward")
+    p.add_argument("--kv-heads", type=int, default=None, metavar="N",
+                   help="grouped-query attention "
+                        "(GPTConfig.num_kv_heads): train with N KV heads "
+                        "(must divide the config's num_heads) — the QKV "
+                        "projection shrinks and serving stores N-head "
+                        "pages (r14)")
+    p.add_argument("--window", type=int, default=None, metavar="W",
+                   help="sliding-window attention "
+                        "(GPTConfig.attn_window): causal attention over "
+                        "the last W positions, trained with the same "
+                        "mask serving decodes under (r14)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--metrics-dir", default=None, metavar="DIR",
                    help="train-side observability (r11): loss / step "
@@ -79,7 +90,8 @@ def main():
               "medium": gpt_mod.gpt_medium, "1p3b": gpt_mod.gpt_1p3b,
               "13b": gpt_mod.gpt_13b}[args.config]
     cfg = cfg_fn(use_parallel=args.mp > 1, seq_major=args.seq_major,
-                 int8=args.int8)
+                 int8=args.int8, num_kv_heads=args.kv_heads,
+                 attn_window=args.window)
     seq = args.seq or min(cfg.max_seq_len, 512)
 
     paddle.seed(args.seed)

@@ -25,15 +25,33 @@ from ..ops.registry import register_op
 
 
 def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False,
-                    dropout_p=0.0, rng=None):
+                    dropout_p=0.0, rng=None, window=None):
     """q,k,v: [..., seq, head_dim] (any leading batch/head dims).  Dropout is
-    applied to the attention PROBABILITIES (paddle/reference semantics)."""
+    applied to the attention PROBABILITIES (paddle/reference semantics).
+
+    GQA: k/v may carry FEWER heads on dim -3 than q (a divisor) — query
+    heads are grouped over the shared K/V head by a reshape, never by
+    repeating K/V.  ``window`` (with ``is_causal``) restricts each query to
+    the trailing ``window`` positions: ``kv_pos in (q_pos - window, q_pos]``.
+    """
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    logits = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(s, q.dtype)
+    nh = q.shape[-3] if q.ndim >= 3 else 1
+    nkv = k.shape[-3] if k.ndim >= 3 else 1
+    grouped = q.ndim >= 3 and nh != nkv
+    if grouped:
+        g = nh // nkv
+        qg = q.reshape(q.shape[:-3] + (nkv, g, q.shape[-2], d))
+        logits = jnp.einsum("...gqd,...kd->...gqk", qg, k) * jnp.asarray(s, q.dtype)
+    else:
+        logits = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(s, q.dtype)
     if is_causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
-        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        qpos = jnp.arange(kl - ql, kl)[:, None]
+        kpos = jnp.arange(kl)[None, :]
+        causal = kpos <= qpos
+        if window is not None:
+            causal = causal & (kpos > qpos - window)
         logits = jnp.where(causal, logits, jnp.asarray(-1e9, logits.dtype))
     if mask is not None:
         if mask.dtype == jnp.bool_:
@@ -45,11 +63,14 @@ def _sdpa_reference(q, k, v, mask=None, scale=None, is_causal=False,
         keep = jax.random.uniform(
             rng, probs.shape, dtype=jnp.float32) < jnp.float32(1.0 - dropout_p)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), jnp.zeros_like(probs))
+    if grouped:
+        out = jnp.einsum("...gqk,...kd->...gqd", probs, v)
+        return out.reshape(q.shape[:-1] + (v.shape[-1],))
     return jnp.einsum("...qk,...kd->...qd", probs, v)
 
 
 def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
-         rng=None, layout="bnsd"):
+         rng=None, layout="bnsd", window=None):
     """Dispatch to the Pallas flash kernel on TPU when profitable, else the
     XLA-fused reference (dropout always takes the reference path).
 
@@ -57,7 +78,9 @@ def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
     projection) feeds the seq-major kernel specs directly — no materialized
     transposes around the custom call (flash._fwd_call_smajor).
     ``layout="sbnd"`` ([s, b, nh, d]) is the end-to-end [S, B, H] activation
-    layout (GPTConfig.seq_major), likewise consumed in place."""
+    layout (GPTConfig.seq_major), likewise consumed in place.  GQA (k/v with
+    fewer heads) and ``window`` thread through to the kernel's in-kernel
+    group gather / window mask."""
     from . import flash
     from ..framework import flags
 
@@ -67,7 +90,7 @@ def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
             and flash.supported(q, k, mask=mask, dropout_p=dropout_p,
                                 layout=layout)):
         return flash.flash_attention(q, k, v, causal=is_causal, scale=scale,
-                                     layout=layout)
+                                     layout=layout, window=window)
     if layout in ("bsnd", "sbnd"):
         if q.ndim != 4:
             raise ValueError(
@@ -78,11 +101,11 @@ def sdpa(q, k, v, mask=None, scale=None, is_causal=False, dropout_p=0.0,
             if layout == "sbnd" else (lambda a: jnp.swapaxes(a, 1, 2))
         out = _sdpa_reference(to_bnsd(q), to_bnsd(k), to_bnsd(v), mask=mask,
                               scale=scale, is_causal=is_causal,
-                              dropout_p=dropout_p, rng=rng)
+                              dropout_p=dropout_p, rng=rng, window=window)
         return (jnp.transpose(out, (2, 0, 1, 3)) if layout == "sbnd"
                 else jnp.swapaxes(out, 1, 2))
     return _sdpa_reference(q, k, v, mask=mask, scale=scale, is_causal=is_causal,
-                           dropout_p=dropout_p, rng=rng)
+                           dropout_p=dropout_p, rng=rng, window=window)
 
 
 @register_op("scaled_dot_product_attention", needs_rng=True)
@@ -98,13 +121,14 @@ def sdpa_kernel(ins, attrs, rng=None):
         is_causal=attrs.get("is_causal", False),
         dropout_p=p, rng=rng,
         layout=attrs.get("layout", "bnsd"),
+        window=attrs.get("window"),
     )
     return {"Out": out}
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True,
-                                 layout="bnsd"):
+                                 layout="bnsd", window=None):
     from ..ops.dispatch import dispatch, single
 
     ins = {"Q": [query], "K": [key], "V": [value]}
@@ -115,6 +139,6 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             "scaled_dot_product_attention",
             ins,
             {"dropout_p": dropout_p, "is_causal": is_causal,
-             "is_test": not training, "layout": layout},
+             "is_test": not training, "layout": layout, "window": window},
         )
     )

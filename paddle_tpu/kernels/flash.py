@@ -72,7 +72,7 @@ def _pick_block(s: int, want: int = 512):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, grid_axis=1):
+                block_k, grid_axis=1, window=None):
     q = q_ref[...]
     bq, d = q.shape
     s_len = k_ref.shape[0]
@@ -92,6 +92,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                          // jnp.int32(block_k), jnp.int32(nkb))
     else:
         hi = nkb
+    if causal and window is not None:
+        # sliding window: the earliest k visible to this q-block's first
+        # row is i*bq - window + 1 — k-blocks wholly before it are skipped
+        lo = jnp.maximum(
+            (i * jnp.int32(bq) - jnp.int32(window - 1)) // jnp.int32(block_k),
+            jnp.int32(0))
+    else:
+        lo = jnp.int32(0)
 
     def body(j, carry):
         m, l, acc = carry
@@ -102,7 +110,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         if causal:
             qi = i * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kj = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(qi >= kj, s, jnp.float32(_NEG_INF))
+            keep = qi >= kj
+            if window is not None:
+                keep = keep & (kj > qi - jnp.int32(window))
+            s = jnp.where(keep, s, jnp.float32(_NEG_INF))
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -114,14 +125,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     # pin the bounds to i32: in interpret mode the body is evaluated under
     # the CALLER's dtype config, where jax_enable_x64 would promote the
     # python-int lower bound to i64 against an i32 upper bound
-    m, l, acc = lax.fori_loop(jnp.int32(0), jnp.asarray(hi, jnp.int32),
+    m, l, acc = lax.fori_loop(lo, jnp.asarray(hi, jnp.int32),
                               body, (m0, l0, acc0))
     l = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
     lse_ref[...] = (m + jnp.log(l)).reshape(1, bq)
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret,
+               window=None):
     bh, s_len, d = q3.shape
     nq = s_len // block_q
     # Mosaic has no 64-bit types; trace the kernel with x64 promotion off so
@@ -129,15 +141,15 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret):
     # int64/f64 scalars into the lowering.
     with _x64_off():
         out, lse = _fwd_call(q3, k3, v3, scale, causal, block_q, block_k,
-                             interpret, bh, s_len, d, nq)
+                             interpret, bh, s_len, d, nq, window)
     return out, lse
 
 
 def _fwd_call(q3, k3, v3, scale, causal, block_q, block_k, interpret,
-              bh, s_len, d, nq):
+              bh, s_len, d, nq, window=None):
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, window=window),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
@@ -163,7 +175,7 @@ def _fwd_call(q3, k3, v3, scale, causal, block_q, block_k, interpret,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, block_k, grid_axis=1):
+                   scale, causal, block_k, grid_axis=1, window=None):
     q = q_ref[...]
     do = do_ref[...].astype(jnp.float32)
     bq, d = q.shape
@@ -178,6 +190,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                          // jnp.int32(block_k), jnp.int32(nkb))
     else:
         hi = nkb
+    if causal and window is not None:
+        lo = jnp.maximum(
+            (i * jnp.int32(bq) - jnp.int32(window - 1)) // jnp.int32(block_k),
+            jnp.int32(0))
+    else:
+        lo = jnp.int32(0)
 
     def body(j, dq):
         k = k_ref[pl.ds(j * block_k, block_k), :]
@@ -187,7 +205,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         if causal:
             qi = i * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             kj = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(qi >= kj, s, jnp.float32(_NEG_INF))
+            keep = qi >= kj
+            if window is not None:
+                keep = keep & (kj > qi - jnp.int32(window))
+            s = jnp.where(keep, s, jnp.float32(_NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v.astype(jnp.float32).T,
                      preferred_element_type=jnp.float32)
@@ -195,14 +216,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         return dq + jnp.dot(ds.astype(k.dtype), k,
                             preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(jnp.int32(0), jnp.asarray(hi, jnp.int32), body,
+    dq = lax.fori_loop(lo, jnp.asarray(hi, jnp.int32), body,
                        jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q,
-                    grid_axis=1):
+                    grid_axis=1, window=None):
     k = k_ref[...]
     v = v_ref[...]
     bk, d = k.shape
@@ -211,6 +232,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     nqb = s_len // block_q
     lo = (j * jnp.int32(bk)) // jnp.int32(block_q) if causal else 0
+    if causal and window is not None:
+        # last q that can see this k-block is (j+1)*bk - 1 + window - 1
+        hi = jnp.minimum(
+            ((j + 1) * jnp.int32(bk) + jnp.int32(window - 1)
+             + jnp.int32(block_q - 1)) // jnp.int32(block_q),
+            jnp.int32(nqb))
+    else:
+        hi = jnp.int32(nqb)
 
     def body(i, carry):
         dk, dv = carry
@@ -223,7 +252,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             qi = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             kj = j * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(qi >= kj, s, jnp.float32(_NEG_INF))
+            keep = qi >= kj
+            if window is not None:
+                keep = keep & (kj > qi - jnp.int32(window))
+            s = jnp.where(keep, s, jnp.float32(_NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dv = dv + jnp.dot(p.T.astype(do.dtype), do,
                           preferred_element_type=jnp.float32)
@@ -236,21 +268,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(jnp.asarray(lo, jnp.int32),
-                           jnp.asarray(nqb, jnp.int32), body, (dk0, dv0))
+    dk, dv = lax.fori_loop(jnp.asarray(lo, jnp.int32), hi, body, (dk0, dv0))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
-               interpret):
+               interpret, window=None):
     with _x64_off():
         return _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q,
-                         block_k, interpret)
+                         block_k, interpret, window)
 
 
 def _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
-              interpret):
+              interpret, window=None):
     bh, s_len, d = q3.shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(bh, 1, s_len)
@@ -258,7 +289,7 @@ def _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
     nq = s_len // block_q
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, window=window),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
@@ -276,7 +307,7 @@ def _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
     nk = s_len // block_k
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, window=window),
         grid=(bh, nk),
         in_specs=[
             pl.BlockSpec((None, s_len, d), lambda b, j: (b, 0, 0)),
@@ -315,20 +346,32 @@ def _bwd_call(q3, k3, v3, out, lse, do, scale, causal, block_q, block_k,
 # with a computed head index.
 
 
-def _smajor_specs(b, s_len, nh, d, block, what, seq_first=False):
+def _smajor_specs(b, s_len, nh, d, block, what, seq_first=False, nkv=None):
     """BlockSpecs for [b, s, nh*d] arrays (one head-column slab per
     program) and (b*nh, 1, s) lse/delta rows; grid = (b, nh, blocks).
     ``seq_first=True`` selects [s, b, nh*d] arrays instead — the model's
     end-to-end [S, B, H] activation layout — with the same squeezed
-    (block, d) kernel blocks, so the kernel bodies are shared."""
-    if what == "tile":
+    (block, d) kernel blocks, so the kernel bodies are shared.
+
+    GQA: ``kv_tile``/``kv_full`` address [.., .., nkv*d] K/V arrays with the
+    head index mapped through the query-head group (h -> h // (nh//nkv)) —
+    the gather happens in the index_map, so K/V are never repeated in HBM
+    and consecutive query heads of a group reuse the resident VMEM block."""
+    g = 1 if nkv is None else nh // nkv
+    if what in ("tile", "kv_tile"):
+        hmap = (lambda h: h) if what == "tile" else (lambda h: h // g)
         if seq_first:
-            return pl.BlockSpec((block, None, d), lambda b_, h, i: (i, b_, h))
-        return pl.BlockSpec((None, block, d), lambda b_, h, i: (b_, i, h))
-    if what == "full":
+            return pl.BlockSpec((block, None, d),
+                                lambda b_, h, i: (i, b_, hmap(h)))
+        return pl.BlockSpec((None, block, d),
+                            lambda b_, h, i: (b_, i, hmap(h)))
+    if what in ("full", "kv_full"):
+        hmap = (lambda h: h) if what == "full" else (lambda h: h // g)
         if seq_first:
-            return pl.BlockSpec((s_len, None, d), lambda b_, h, i: (0, b_, h))
-        return pl.BlockSpec((None, s_len, d), lambda b_, h, i: (b_, 0, h))
+            return pl.BlockSpec((s_len, None, d),
+                                lambda b_, h, i: (0, b_, hmap(h)))
+        return pl.BlockSpec((None, s_len, d),
+                            lambda b_, h, i: (b_, 0, hmap(h)))
     if what == "row":
         return pl.BlockSpec((None, 1, block),
                             lambda b_, h, i, nh=nh: (b_ * nh + h, 0, i))
@@ -339,7 +382,7 @@ def _smajor_specs(b, s_len, nh, d, block, what, seq_first=False):
 
 
 def _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q, block_k,
-                     interpret, seq_first=False):
+                     interpret, seq_first=False, nkv=None, window=None):
     if seq_first:
         s_len, b, H = q3.shape
         act_shape = (s_len, b, H)
@@ -351,17 +394,17 @@ def _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q, block_k,
 
     def sp(what, block):
         return _smajor_specs(b, s_len, nh, d, block, what,
-                             seq_first=seq_first)
+                             seq_first=seq_first, nkv=nkv)
 
     with _x64_off():
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                              block_k=block_k, grid_axis=2),
+                              block_k=block_k, grid_axis=2, window=window),
             grid=(b, nh, nq),
             in_specs=[
                 sp("tile", block_q),
-                sp("full", block_q),
-                sp("full", block_q),
+                sp("kv_full", block_q),
+                sp("kv_full", block_q),
             ],
             out_specs=[
                 sp("tile", block_q),
@@ -377,7 +420,8 @@ def _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q, block_k,
 
 
 def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
-                     block_k, interpret, seq_first=False):
+                     block_k, interpret, seq_first=False, nkv=None,
+                     window=None):
     if seq_first:
         s_len, b, H = q3.shape
         act_shape = (s_len, b, H)
@@ -388,7 +432,7 @@ def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
 
     def sp(what, block):
         return _smajor_specs(b, s_len, nh, d, block, what,
-                             seq_first=seq_first)
+                             seq_first=seq_first, nkv=nkv)
 
     with _x64_off():
         dsum = jnp.sum((do.astype(jnp.float32) * out.astype(jnp.float32))
@@ -401,12 +445,12 @@ def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
         nq = s_len // block_q
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                              block_k=block_k, grid_axis=2),
+                              block_k=block_k, grid_axis=2, window=window),
             grid=(b, nh, nq),
             in_specs=[
                 sp("tile", block_q),
-                sp("full", block_q),
-                sp("full", block_q),
+                sp("kv_full", block_q),
+                sp("kv_full", block_q),
                 sp("tile", block_q),
                 sp("row", block_q),
                 sp("row", block_q),
@@ -417,14 +461,18 @@ def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
         )(q3, k3, v3, do, lse, delta)
 
         nk = s_len // block_k
+        # dk/dv are emitted at QUERY-head granularity (each program owns its
+        # (h, k-block) tile exclusively) and group-summed below — the sum
+        # over a group is the mathematically required reduction, done once
+        # outside the kernel instead of via cross-program accumulation.
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                              block_q=block_q, grid_axis=2),
+                              block_q=block_q, grid_axis=2, window=window),
             grid=(b, nh, nk),
             in_specs=[
                 sp("full", block_k),
-                sp("tile", block_k),
-                sp("tile", block_k),
+                sp("kv_tile", block_k),
+                sp("kv_tile", block_k),
                 sp("full", block_k),
                 sp("row_full", block_k),
                 sp("row_full", block_k),
@@ -439,30 +487,40 @@ def _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal, block_q,
             ],
             interpret=interpret,
         )(q3, k3, v3, do, lse, delta)
+        if nkv is not None and nkv != nh:
+            g = nh // nkv
+            red = act_shape[:2] + (nkv, g, d)
+            kv_shape = act_shape[:2] + (nkv * d,)
+            dk = dk.astype(jnp.float32).reshape(red).sum(axis=3) \
+                .reshape(kv_shape).astype(k3.dtype)
+            dv = dv.astype(jnp.float32).reshape(red).sum(axis=3) \
+                .reshape(kv_shape).astype(v3.dtype)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6))
-def _flash_smajor(nh, causal, scale, block_q, block_k, interpret, seq_first,
-                  q3, k3, v3):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+def _flash_smajor(nh, nkv, causal, scale, window, block_q, block_k,
+                  interpret, seq_first, q3, k3, v3):
     out, _ = _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q,
-                              block_k, interpret, seq_first=seq_first)
+                              block_k, interpret, seq_first=seq_first,
+                              nkv=nkv, window=window)
     return out
 
 
-def _flash_smajor_fwd(nh, causal, scale, block_q, block_k, interpret,
-                      seq_first, q3, k3, v3):
+def _flash_smajor_fwd(nh, nkv, causal, scale, window, block_q, block_k,
+                      interpret, seq_first, q3, k3, v3):
     out, lse = _fwd_call_smajor(q3, k3, v3, nh, scale, causal, block_q,
-                                block_k, interpret, seq_first=seq_first)
+                                block_k, interpret, seq_first=seq_first,
+                                nkv=nkv, window=window)
     return out, (q3, k3, v3, out, lse)
 
 
-def _flash_smajor_bwd(nh, causal, scale, block_q, block_k, interpret,
-                      seq_first, res, do):
+def _flash_smajor_bwd(nh, nkv, causal, scale, window, block_q, block_k,
+                      interpret, seq_first, res, do):
     q3, k3, v3, out, lse = res
     return _bwd_call_smajor(q3, k3, v3, out, lse, do, nh, scale, causal,
                             block_q, block_k, interpret,
-                            seq_first=seq_first)
+                            seq_first=seq_first, nkv=nkv, window=window)
 
 
 _flash_smajor.defvjp(_flash_smajor_fwd, _flash_smajor_bwd)
@@ -473,21 +531,25 @@ _flash_smajor.defvjp(_flash_smajor_fwd, _flash_smajor_bwd)
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
-def _flash(causal, scale, block_q, block_k, interpret, q3, k3, v3):
-    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def _flash(causal, scale, window, block_q, block_k, interpret, q3, k3, v3):
+    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                        interpret, window)
     return out
 
 
-def _flash_fwd_rule(causal, scale, block_q, block_k, interpret, q3, k3, v3):
-    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, interpret)
+def _flash_fwd_rule(causal, scale, window, block_q, block_k, interpret,
+                    q3, k3, v3):
+    out, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                          interpret, window)
     return out, (q3, k3, v3, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, scale, window, block_q, block_k, interpret,
+                    res, do):
     q3, k3, v3, out, lse = res
     dq, dk, dv = _flash_bwd(q3, k3, v3, out, lse, do, scale, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret, window)
     return dq, dk, dv
 
 
@@ -503,19 +565,26 @@ def _layout_s_axis(layout, ndim=4):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
-                    block_q=None, block_k=None, layout="bnsd"):
+                    block_q=None, block_k=None, layout="bnsd", window=None):
     """Flash attention.  ``layout="bnsd"``: [..., seq, head_dim] (q/k same
     length); ``layout="bsnd"``: [batch, seq, heads, head_dim] — consumed
     seq-major IN PLACE, so the caller pays no materialized [b,nh,s,d]
     transposes around the custom call; ``layout="sbnd"``: [seq, batch,
     heads, head_dim] — the model's end-to-end [S, B, H] activation layout
-    (GPTConfig.seq_major), also consumed in place.  Raises ValueError on
-    unsupported shapes — callers should gate on :func:`supported` first
-    (the sdpa dispatcher does)."""
+    (GPTConfig.seq_major), also consumed in place.  The seq-major layouts
+    accept GQA (k/v with fewer heads, a divisor of q's) — query-head groups
+    are gathered onto the shared K/V head inside the BlockSpec index maps.
+    ``window`` (causal only) masks keys older than ``window`` positions and
+    skips fully-masked blocks.  Raises ValueError on unsupported shapes —
+    callers should gate on :func:`supported` first (the sdpa dispatcher
+    does)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = not _backend_is_tpu()
+    if window is not None and not causal:
+        raise ValueError("flash_attention: window requires causal=True")
+    win = None if window is None else int(window)
     s_axis = _layout_s_axis(layout, q.ndim)
     s_len = q.shape[s_axis]
     bq = block_q or _pick_block(s_len)
@@ -529,21 +598,33 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=None,
         seq_first = layout == "sbnd"
         if seq_first:
             _, b, nh, d = q.shape
+            nkv = k.shape[2]
             flat = (s_len, b, nh * d)
+            kv_flat = (s_len, b, nkv * d)
         else:
             b, _, nh, d = q.shape
+            nkv = k.shape[2]
             flat = (b, s_len, nh * d)
-        out = _flash_smajor(int(nh), causal, float(scale), int(bq), int(bk),
-                            bool(interpret), seq_first,
-                            q.reshape(flat), k.reshape(flat),
-                            v.reshape(flat))
+            kv_flat = (b, s_len, nkv * d)
+        if nh % nkv != 0:
+            raise ValueError(
+                f"flash_attention: q heads {nh} not a multiple of kv heads "
+                f"{nkv}")
+        out = _flash_smajor(int(nh), int(nkv), causal, float(scale), win,
+                            int(bq), int(bk), bool(interpret), seq_first,
+                            q.reshape(flat), k.reshape(kv_flat),
+                            v.reshape(kv_flat))
         return out.reshape(q.shape)
+    if q.ndim >= 3 and q.shape[-3] != k.shape[-3]:
+        raise ValueError(
+            "flash_attention: GQA (mismatched head counts) requires a "
+            "seq-major layout (bsnd/sbnd)")
     lead = q.shape[:-2]
     d = q.shape[-1]
     q3 = q.reshape((-1, s_len, d))
     k3 = k.reshape((-1, s_len, d))
     v3 = v.reshape((-1, s_len, d))
-    out = _flash(causal, float(scale), int(bq), int(bk), bool(interpret),
+    out = _flash(causal, float(scale), win, int(bq), int(bk), bool(interpret),
                  q3, k3, v3)
     return out.reshape(lead + (s_len, d))
 
@@ -557,6 +638,14 @@ def supported(q, k, mask=None, dropout_p=0.0, layout="bnsd") -> bool:
         return False
     if q.ndim < 3 or q.shape[s_axis] != k.shape[s_axis]:
         return False
+    # GQA: only the seq-major layouts gather query-head groups in their
+    # index maps; the bnsd flat (-1, s, d) reshape can't express it
+    if q.ndim >= 3:
+        h_axis = 2 if layout in ("bsnd", "sbnd") else -3
+        nh, nkv = q.shape[h_axis], k.shape[h_axis]
+        if nh != nkv:
+            if layout not in ("bsnd", "sbnd") or nkv == 0 or nh % nkv != 0:
+                return False
     # head_dim gate: Mosaic wants lane-aligned (multiple-of-8) head dims in a
     # validated range; odd geometries (80, 12, ...) take the XLA sdpa path
     # instead of failing at lowering (ADVICE round 2)

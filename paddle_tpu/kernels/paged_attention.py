@@ -62,17 +62,26 @@ def available() -> bool:
     return _backend_is_tpu()
 
 
-def supported(n_heads: int, page_size: int, head_dim: int) -> bool:
+def supported(n_heads: int, page_size: int, head_dim: int,
+              n_kv_heads: int | None = None,
+              kv_bits: int | None = None) -> bool:
     """Shape gate for the fused kernel: lane-aligned head_dim and a
     sublane-aligned page (the int8 tile is (32, 128); bf16 is (16, 128)).
+    GQA needs the group to divide evenly; int4 pages DMA a packed
+    ``head_dim // 2`` lane dim, which must itself be lane-aligned.
     Ragged shapes take the jnp reference path instead of failing at
     lowering."""
-    if head_dim % 128 != 0:
+    nkv = n_kv_heads or n_heads
+    if n_heads % nkv != 0:
+        return False
+    lane_d = head_dim // 2 if kv_bits == 4 else head_dim
+    if lane_d % 128 != 0:
         return False
     if page_size % 32 != 0:
         return False
-    # VMEM: q (H, D) + K/V pages (H, ps, D) + scratch; tiny vs 16MB/core
-    return n_heads * page_size * head_dim * 4 * 2 < 8 * 1024 * 1024
+    # VMEM: q (H, D) + K/V pages (Hkv, ps, D) + scratch; tiny vs 16MB/core
+    return (n_heads * head_dim + 2 * nkv * page_size * head_dim) * 4 \
+        < 8 * 1024 * 1024
 
 
 def _pad_q_tile(q_tile: int) -> int:
@@ -83,24 +92,43 @@ def _pad_q_tile(q_tile: int) -> int:
 
 
 def supported_mq(n_heads: int, page_size: int, head_dim: int,
-                 q_tile: int) -> bool:
+                 q_tile: int, n_kv_heads: int | None = None,
+                 kv_bits: int | None = None) -> bool:
     """Shape gate for the multi-query verify kernel — the decode gate
     plus the padded query block's VMEM footprint (same arithmetic as
     paged_prefill.supported with chunk = padded q_tile)."""
-    if head_dim % 128 != 0 or page_size % 32 != 0:
+    nkv = n_kv_heads or n_heads
+    if n_heads % nkv != 0:
+        return False
+    lane_d = head_dim // 2 if kv_bits == 4 else head_dim
+    if lane_d % 128 != 0 or page_size % 32 != 0:
         return False
     tp = _pad_q_tile(q_tile)
     vmem = 4 * (2 * tp * n_heads * head_dim
-                + 2 * n_heads * page_size * head_dim)
+                + 2 * nkv * page_size * head_dim)
     return vmem < 8 * 1024 * 1024
 
 
+def _unpack4_vmem(pk):
+    """In-VMEM int4 nibble unpack: the packed (.., ps, D/2) int8 page block
+    -> (.., ps, D) fp32, calling the ONE pack/unpack definition
+    (ops/quant_ops.unpack_int4) so the paged dequant cannot fork from the
+    dense cache's."""
+    from ..ops.quant_ops import unpack_int4
+
+    return unpack_int4(pk).astype(jnp.float32)
+
+
 def _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                     page_size, scale):
-    """The ONE online-softmax page step shared by the float and int8 kernel
-    entries (only how k/v are materialized in VMEM differs): init scratch
-    on the first page, score + length-mask this page, fold it into the
-    m/l/acc flash recurrence, divide out on the last page."""
+                     page_size, scale, window=None, n_kv=None):
+    """The ONE online-softmax page step shared by the float/int8/int4
+    kernel entries (only how k/v are materialized in VMEM differs): init
+    scratch on the first page, score + length-mask this page (plus the
+    sliding-window lower bound when ``window`` is set), fold it into the
+    m/l/acc flash recurrence, divide out on the last page.  Under GQA
+    (``n_kv`` < q's head count) the query heads regroup over the shared
+    K/V head with leading-dim reshapes — K/V stay at ``n_kv`` heads in
+    VMEM, never repeated."""
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -111,19 +139,38 @@ def _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0].astype(jnp.float32)                       # (H, D)
-    s = jnp.einsum("hd,hsd->hs", q, k,
-                   preferred_element_type=jnp.float32) * scale  # (H, ps)
+    h, d = q.shape
+    nkv = n_kv or h
+    if nkv != h:
+        g = h // nkv
+        qg = q.reshape(nkv, g, d)
+        s = jnp.einsum("ngd,nsd->ngs", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(h, page_size)                        # (H, ps)
+    else:
+        s = jnp.einsum("hd,hsd->hs", q, k,
+                       preferred_element_type=jnp.float32) * scale  # (H, ps)
     base = p * jnp.int32(page_size)
     pos = base + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
-    s = jnp.where(pos < len_ref[b], s, jnp.float32(_NEG_INF))
+    keep = pos < len_ref[b]
+    if window is not None:
+        keep = keep & (pos >= len_ref[b] - jnp.int32(window))
+    s = jnp.where(keep, s, jnp.float32(_NEG_INF))
 
     m_prev = m_ref[:, :1]                                  # (H, 1)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
     pexp = jnp.exp(s - m_new)
     l_new = l_ref[:, :1] * alpha + jnp.sum(pexp, axis=1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * alpha + jnp.einsum(
-        "hs,hsd->hd", pexp, v, preferred_element_type=jnp.float32)
+    if nkv != h:
+        g = h // nkv
+        pg = pexp.reshape(nkv, g, page_size)
+        upd = jnp.einsum("ngs,nsd->ngd", pg, v,
+                         preferred_element_type=jnp.float32).reshape(h, d)
+    else:
+        upd = jnp.einsum("hs,hsd->hd", pexp, v,
+                         preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + upd
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -133,58 +180,81 @@ def _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
 
 
 def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, page_size, scale):
-    k = k_ref[0].astype(jnp.float32)                       # (H, ps, D)
+                  m_ref, l_ref, acc_ref, *, page_size, scale, window=None,
+                  n_kv=None):
+    k = k_ref[0].astype(jnp.float32)                       # (Hkv, ps, D)
     v = v_ref[0].astype(jnp.float32)
     _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                     page_size, scale)
+                     page_size, scale, window=window, n_kv=n_kv)
 
 
 # the int8 entry has its own arity (scale refs) but the same recurrence
 def _paged_kernel_int8(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
-                       o_ref, m_ref, l_ref, acc_ref, *, page_size, scale):
+                       o_ref, m_ref, l_ref, acc_ref, *, page_size, scale,
+                       window=None, n_kv=None):
     # dequant fused right after the page DMA: int8 values * fp32
     # per-(head, position) scale, in VMEM
-    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (H, ps, D)
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (Hkv, ps, D)
     v = v_ref[0].astype(jnp.float32) * vs_ref[0]
     _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                     page_size, scale)
+                     page_size, scale, window=window, n_kv=n_kv)
+
+
+# the int4 entry: packed nibble pages, unpack + dequant fused after the DMA
+def _paged_kernel_int4(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                       o_ref, m_ref, l_ref, acc_ref, *, page_size, scale,
+                       window=None, n_kv=None):
+    k = _unpack4_vmem(k_ref[0]) * ks_ref[0]                # (Hkv, ps, D)
+    v = _unpack4_vmem(v_ref[0]) * vs_ref[0]
+    _page_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                     page_size, scale, window=window, n_kv=n_kv)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     k_scales=None, v_scales=None, scale=None,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None, window=None):
     """Single-query decode attention through a paged KV pool.
 
-    ``q`` (B, H, D) float; ``k_pages``/``v_pages`` (P, H, page_size, D)
-    float — or int8 with ``k_scales``/``v_scales`` (P, H, page_size, 1)
-    fp32; ``block_tables`` (B, max_pages) int32 page ids (padding entries
-    must reference a valid page — the pool's null page 0); ``lengths``
-    (B,) int32 valid-position counts.  Returns (B, H, D) in q.dtype.
-    Callers gate on :func:`available`/:func:`supported` first.
+    ``q`` (B, H, D) float; ``k_pages``/``v_pages`` (P, Hkv, page_size, D)
+    float (Hkv a divisor of H — GQA regroups query heads in VMEM, the
+    pages never repeat) — or int8 with ``k_scales``/``v_scales``
+    (P, Hkv, page_size, 1) fp32, or PACKED int4 (last dim D // 2, two
+    nibbles per byte — detected from the shape) with the same scales
+    layout; ``block_tables`` (B, max_pages) int32 page ids (padding
+    entries must reference a valid page — the pool's null page 0);
+    ``lengths`` (B,) int32 valid-position counts.  ``window`` masks
+    positions below ``lengths - window`` (sliding-window attention — the
+    engine's recycled ring pages point at the null page and fall under
+    this bound).  Returns (B, H, D) in q.dtype.  Callers gate on
+    :func:`available`/:func:`supported` first.
     """
     b, h, d = q.shape
-    _, _, ps, _ = k_pages.shape
+    _, hkv, ps, d_store = k_pages.shape
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     scale = np.float32(scale)
     if interpret is None:
         interpret = not _backend_is_tpu()
-    int8 = k_scales is not None
+    win = None if window is None else int(window)
+    nkv = None if hkv == h else hkv
+    quant = k_scales is not None
+    int4 = quant and d_store != d
 
     q_spec = pl.BlockSpec((1, h, d), lambda b, p, bt, ln: (b, 0, 0))
-    pg_spec = pl.BlockSpec((1, h, ps, d),
+    pg_spec = pl.BlockSpec((1, hkv, ps, d_store),
                            lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
-    sc_spec = pl.BlockSpec((1, h, ps, 1),
+    sc_spec = pl.BlockSpec((1, hkv, ps, 1),
                            lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
-    if int8:
-        kernel = functools.partial(_paged_kernel_int8, page_size=ps,
-                                   scale=scale)
+    if quant:
+        kern = _paged_kernel_int4 if int4 else _paged_kernel_int8
+        kernel = functools.partial(kern, page_size=ps, scale=scale,
+                                   window=win, n_kv=nkv)
         in_specs = [q_spec, pg_spec, sc_spec, pg_spec, sc_spec]
         args = (q, k_pages, k_scales, v_pages, v_scales)
     else:
-        kernel = functools.partial(_paged_kernel, page_size=ps, scale=scale)
+        kernel = functools.partial(_paged_kernel, page_size=ps, scale=scale,
+                                   window=win, n_kv=nkv)
         in_specs = [q_spec, pg_spec, pg_spec]
         args = (q, k_pages, v_pages)
 
@@ -207,13 +277,16 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
 
 
 def _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                   page_size, scale, t):
+                   page_size, scale, t, window=None, n_kv=None):
     """The online-softmax page step of the MULTI-query (speculative
     verify) kernel: q_tile rows per slot, row i at global position
     ``lengths[b] + i``, causally visible to page position j iff
     ``j <= lengths[b] + i`` — the paged_prefill causal rule with the
     slot's length as the chunk start, batched over slots like the decode
-    kernel.  Shared by the float and int8 entries (only how k/v
+    kernel; ``window`` adds the sliding-window lower bound
+    ``j > lengths[b] + i - window``.  GQA (``n_kv``) regroups query heads
+    over the shared K/V head with leading-dim reshapes, like the decode
+    recurrence.  Shared by the float/int8/int4 entries (only how k/v
     materialize in VMEM differs)."""
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -225,20 +298,40 @@ def _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0].astype(jnp.float32)                       # (T, H, D)
-    s = jnp.einsum("thd,hsd->hts", q, k,
-                   preferred_element_type=jnp.float32) * scale  # (H, T, ps)
+    h, d = q.shape[1], q.shape[2]
+    nkv = n_kv or h
+    if nkv != h:
+        g = h // nkv
+        qg = q.reshape(t, nkv, g, d)
+        s = jnp.einsum("tngd,nsd->ngts", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(h, t, page_size)                     # (H, T, ps)
+    else:
+        s = jnp.einsum("thd,hsd->hts", q, k,
+                       preferred_element_type=jnp.float32) * scale
     pos = p * jnp.int32(page_size) + jax.lax.broadcasted_iota(
         jnp.int32, (1, 1, page_size), 2)
     qpos = len_ref[b] + jax.lax.broadcasted_iota(jnp.int32, (1, t, 1), 1)
-    s = jnp.where(pos <= qpos, s, jnp.float32(_NEG_INF))
+    keep = pos <= qpos
+    if window is not None:
+        keep = keep & (pos > qpos - jnp.int32(window))
+    s = jnp.where(keep, s, jnp.float32(_NEG_INF))
 
     m_prev = m_ref[...]                                    # (H, T)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
     alpha = jnp.exp(m_prev - m_new)
     pexp = jnp.exp(s - m_new[:, :, None])
     l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=2)
-    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + jnp.einsum(
-        "hts,hsd->htd", pexp, v, preferred_element_type=jnp.float32)
+    if nkv != h:
+        g = h // nkv
+        pg = pexp.reshape(nkv, g, t, page_size)
+        upd = jnp.einsum("ngts,nsd->ngtd", pg, v,
+                         preferred_element_type=jnp.float32) \
+            .reshape(h, t, d)
+    else:
+        upd = jnp.einsum("hts,hsd->htd", pexp, v,
+                         preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + upd
     m_ref[...] = m_new
 
     @pl.when(p == pl.num_programs(1) - 1)
@@ -248,25 +341,37 @@ def _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
 
 
 def _mq_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-               m_ref, l_ref, acc_ref, *, page_size, scale, t):
-    k = k_ref[0].astype(jnp.float32)                       # (H, ps, D)
+               m_ref, l_ref, acc_ref, *, page_size, scale, t, window=None,
+               n_kv=None):
+    k = k_ref[0].astype(jnp.float32)                       # (Hkv, ps, D)
     v = v_ref[0].astype(jnp.float32)
     _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                   page_size, scale, t)
+                   page_size, scale, t, window=window, n_kv=n_kv)
 
 
 # the int8 entry has its own arity (scale refs) but the same recurrence
 def _mq_kernel_int8(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
-                    o_ref, m_ref, l_ref, acc_ref, *, page_size, scale, t):
-    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (H, ps, D)
+                    o_ref, m_ref, l_ref, acc_ref, *, page_size, scale, t,
+                    window=None, n_kv=None):
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (Hkv, ps, D)
     v = v_ref[0].astype(jnp.float32) * vs_ref[0]
     _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                   page_size, scale, t)
+                   page_size, scale, t, window=window, n_kv=n_kv)
+
+
+# the int4 entry: packed nibble pages, unpack + dequant fused after the DMA
+def _mq_kernel_int4(bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                    o_ref, m_ref, l_ref, acc_ref, *, page_size, scale, t,
+                    window=None, n_kv=None):
+    k = _unpack4_vmem(k_ref[0]) * ks_ref[0]                # (Hkv, ps, D)
+    v = _unpack4_vmem(v_ref[0]) * vs_ref[0]
+    _mq_recurrence(len_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                   page_size, scale, t, window=window, n_kv=n_kv)
 
 
 def paged_attention_mq(q, k_pages, v_pages, block_tables, lengths, *,
                        k_scales=None, v_scales=None, scale=None,
-                       interpret: bool | None = None):
+                       interpret: bool | None = None, window=None):
     """Multi-query (speculative verify) decode attention through a paged
     KV pool.
 
@@ -289,34 +394,38 @@ def paged_attention_mq(q, k_pages, v_pages, block_tables, lengths, *,
         out = paged_attention(q[:, 0], k_pages, v_pages, block_tables,
                               lengths + 1, k_scales=k_scales,
                               v_scales=v_scales, scale=scale,
-                              interpret=interpret)
+                              interpret=interpret, window=window)
         return out[:, None]
-    _, _, ps, _ = k_pages.shape
+    _, hkv, ps, d_store = k_pages.shape
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     scale = np.float32(scale)
     if interpret is None:
         interpret = not _backend_is_tpu()
-    int8 = k_scales is not None
+    win = None if window is None else int(window)
+    nkv = None if hkv == h else hkv
+    quant = k_scales is not None
+    int4 = quant and d_store != d
 
     tp = _pad_q_tile(t)
     if tp != t:
         q = jnp.pad(q, ((0, 0), (0, tp - t), (0, 0), (0, 0)))
 
     q_spec = pl.BlockSpec((1, tp, h, d), lambda b, p, bt, ln: (b, 0, 0, 0))
-    pg_spec = pl.BlockSpec((1, h, ps, d),
+    pg_spec = pl.BlockSpec((1, hkv, ps, d_store),
                            lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
-    sc_spec = pl.BlockSpec((1, h, ps, 1),
+    sc_spec = pl.BlockSpec((1, hkv, ps, 1),
                            lambda b, p, bt, ln: (bt[b, p], 0, 0, 0))
-    if int8:
-        kernel = functools.partial(_mq_kernel_int8, page_size=ps,
-                                   scale=scale, t=tp)
+    if quant:
+        kern = _mq_kernel_int4 if int4 else _mq_kernel_int8
+        kernel = functools.partial(kern, page_size=ps, scale=scale, t=tp,
+                                   window=win, n_kv=nkv)
         in_specs = [q_spec, pg_spec, sc_spec, pg_spec, sc_spec]
         args = (q, k_pages, k_scales, v_pages, v_scales)
     else:
         kernel = functools.partial(_mq_kernel, page_size=ps, scale=scale,
-                                   t=tp)
+                                   t=tp, window=win, n_kv=nkv)
         in_specs = [q_spec, pg_spec, pg_spec]
         args = (q, k_pages, v_pages)
 
@@ -340,66 +449,106 @@ def paged_attention_mq(q, k_pages, v_pages, block_tables, lengths, *,
     return out[:, :t]
 
 
-def gather_pages(pages, block_tables, scales=None):
-    """Materialize each slot's paged KV as a dense (B, H, S, D) view
+def gather_pages(pages, block_tables, scales=None, head_dim=None):
+    """Materialize each slot's paged KV as a dense (B, Hkv, S, D) view
     (S = max_pages * page_size): ``pages[block_tables]`` + layout shuffle.
-    With int8 ``scales`` the dequant happens here, making the IDENTICAL
-    dequant decision the fused kernel makes in VMEM."""
+    With quantized ``scales`` the dequant happens here — including the
+    int4 nibble unpack when the pages' last dim is narrower than
+    ``head_dim`` — making the IDENTICAL dequant decision the fused kernel
+    makes in VMEM."""
     p, h, ps, d = pages.shape
     b, max_pages = block_tables.shape
     g = pages[block_tables]                        # (B, max_pages, H, ps, D)
     if scales is not None:
+        if head_dim is not None and d != head_dim:
+            from ..ops.quant_ops import unpack_int4
+
+            g = unpack_int4(g)
+            d = head_dim
         g = g.astype(jnp.float32) * scales[block_tables]
     g = jnp.einsum("bphsd->bhpsd", g)
     return g.reshape(b, h, max_pages * ps, d)
 
 
+def _group_scores(q, k_eff, eq_grouped, eq_flat):
+    """Scores einsum with GQA regrouping: q carries H heads, ``k_eff``
+    Hkv <= H; grouped shapes reshape query heads over the shared K/V head
+    (never repeating K/V), exactly like the dense decoder."""
+    h = q.shape[-2]
+    hkv = k_eff.shape[1]
+    if h == hkv:
+        return jnp.einsum(eq_flat, q, k_eff,
+                          preferred_element_type=jnp.float32), False
+    g = h // hkv
+    if q.ndim == 3:                                # (B, H, D) single query
+        qg = q.reshape(q.shape[0], hkv, g, q.shape[-1])
+    else:                                          # (B, T, H, D) multi query
+        qg = q.reshape(q.shape[0], q.shape[1], hkv, g, q.shape[-1])
+    s = jnp.einsum(eq_grouped, qg, k_eff,
+                   preferred_element_type=jnp.float32)
+    return s, True
+
+
 def paged_attention_ref(q, k_pages, v_pages, block_tables, lengths, *,
-                        k_scales=None, v_scales=None, scale=None):
+                        k_scales=None, v_scales=None, scale=None,
+                        window=None):
     """jnp reference path: gathers the pages dense and runs the EXACT
     einsum/mask/softmax sequence of the dense KV-cache decoder
-    (models/generation._block_fwd), so paged decode is bit-comparable to
-    dense decode — the CPU fallback and the kernel's parity oracle."""
+    (models/generation._block_fwd) — including the GQA grouping, the
+    sliding-window lower bound, and the int4 unpack — so paged decode is
+    bit-comparable to dense decode; the CPU fallback and the kernel's
+    parity oracle."""
     b, h, d = q.shape
     ps = k_pages.shape[2]
+    hkv = k_pages.shape[1]
     s_max = block_tables.shape[1] * ps
-    k_eff = gather_pages(k_pages, block_tables, k_scales)
-    v_eff = gather_pages(v_pages, block_tables, v_scales)
-    s = jnp.einsum("bhd,bhsd->bhs", q, k_eff,
-                   preferred_element_type=jnp.float32)
+    k_eff = gather_pages(k_pages, block_tables, k_scales, head_dim=d)
+    v_eff = gather_pages(v_pages, block_tables, v_scales, head_dim=d)
+    s, grouped = _group_scores(q, k_eff, "bngd,bnsd->bngs", "bhd,bhsd->bhs")
     if scale is None:
         # divide, exactly as the dense decoder scales its scores — keeps
         # the two decode substrates bit-comparable, not just close
         s = s / np.sqrt(d).astype(np.float32)
     else:
         s = s * jnp.float32(scale)
-    mask = jnp.arange(s_max, dtype=jnp.int32)[None, :] < lengths[:, None]
-    s = jnp.where(mask[:, None], s, _NEG_INF)
+    pos = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+    keep = pos < lengths[:, None]
+    if window is not None:
+        keep = keep & (pos >= lengths[:, None] - window)
+    bmask = keep[:, None, None] if grouped else keep[:, None]
+    s = jnp.where(bmask, s, _NEG_INF)
     att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
-    out = jnp.einsum("bhs,bhsd->bhd", att, v_eff)
+    if grouped:
+        out = jnp.einsum("bngs,bnsd->bngd", att, v_eff) \
+            .reshape(b, h, v_eff.shape[-1])
+    else:
+        out = jnp.einsum("bhs,bhsd->bhd", att, v_eff)
     return out.astype(q.dtype)
 
 
 def paged_attention_mq_ref(q, k_pages, v_pages, block_tables, lengths, *,
-                           k_scales=None, v_scales=None, scale=None):
+                           k_scales=None, v_scales=None, scale=None,
+                           window=None):
     """jnp reference for :func:`paged_attention_mq`: gathers the pages
     dense and applies the same causal rule ``page_pos <= lengths[b] + i``
-    with the same dequant decision (gather_pages) — the CPU fallback and
-    the multi-query kernel's parity oracle.  T == 1 dispatches to
-    :func:`paged_attention_ref` (the masks coincide), keeping the r08
-    single-query reference the one definition of that case."""
+    (and window lower bound) with the same dequant/grouping decisions —
+    the CPU fallback and the multi-query kernel's parity oracle.  T == 1
+    dispatches to :func:`paged_attention_ref` (the masks coincide),
+    keeping the r08 single-query reference the one definition of that
+    case."""
     b, t, h, d = q.shape
     if t == 1:
         out = paged_attention_ref(q[:, 0], k_pages, v_pages, block_tables,
                                   lengths + 1, k_scales=k_scales,
-                                  v_scales=v_scales, scale=scale)
+                                  v_scales=v_scales, scale=scale,
+                                  window=window)
         return out[:, None]
     ps = k_pages.shape[2]
     s_max = block_tables.shape[1] * ps
-    k_eff = gather_pages(k_pages, block_tables, k_scales)     # (B, H, S, D)
-    v_eff = gather_pages(v_pages, block_tables, v_scales)
-    s = jnp.einsum("bthd,bhsd->bhts", q, k_eff,
-                   preferred_element_type=jnp.float32)
+    k_eff = gather_pages(k_pages, block_tables, k_scales, head_dim=d)
+    v_eff = gather_pages(v_pages, block_tables, v_scales, head_dim=d)
+    s, grouped = _group_scores(q, k_eff, "btngd,bnsd->bngts",
+                               "bthd,bhsd->bhts")
     if scale is None:
         # divide, exactly as the dense decoder scales its scores — keeps
         # the verify path bit-comparable to dense decode, not just close
@@ -409,7 +558,15 @@ def paged_attention_mq_ref(q, k_pages, v_pages, block_tables, lengths, *,
     pos = jnp.arange(s_max, dtype=jnp.int32)[None, None, :]
     qpos = lengths[:, None, None] + jnp.arange(t, dtype=jnp.int32)[None, :,
                                                                    None]
-    s = jnp.where((pos <= qpos)[:, None], s, _NEG_INF)
+    keep = pos <= qpos
+    if window is not None:
+        keep = keep & (pos > qpos - window)
+    bmask = keep[:, None, None] if grouped else keep[:, None]
+    s = jnp.where(bmask, s, _NEG_INF)
     att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
-    out = jnp.einsum("bhts,bhsd->bthd", att, v_eff)
+    if grouped:
+        out = jnp.einsum("bngts,bnsd->btngd", att, v_eff) \
+            .reshape(b, t, h, v_eff.shape[-1])
+    else:
+        out = jnp.einsum("bhts,bhsd->bthd", att, v_eff)
     return out.astype(q.dtype)

@@ -41,7 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash import _backend_is_tpu, _x64_off
-from .paged_attention import gather_pages
+from .paged_attention import _unpack4_vmem, gather_pages
 
 _NEG_INF = -1e30
 
@@ -53,26 +53,34 @@ def available() -> bool:
     return _backend_is_tpu()
 
 
-def supported(n_heads: int, page_size: int, head_dim: int,
-              chunk: int) -> bool:
-    """Shape gate for the fused kernel: lane-aligned head_dim, a
-    sublane-aligned page and chunk.  Ragged shapes take the jnp reference
-    path instead of failing at lowering."""
-    if head_dim % 128 != 0 or page_size % 32 != 0 or chunk % 8 != 0:
+def supported(n_heads: int, page_size: int, head_dim: int, chunk: int,
+              n_kv_heads: int | None = None,
+              kv_bits: int | None = None) -> bool:
+    """Shape gate for the fused kernel: lane-aligned head_dim (stored
+    width for int4 pages), a sublane-aligned page and chunk, and a query
+    head count that divides evenly over the KV heads.  Ragged shapes take
+    the jnp reference path instead of failing at lowering."""
+    nkv = n_kv_heads or n_heads
+    if n_heads % nkv != 0:
         return False
-    # VMEM: q + acc (chunk, H, D) each, K/V pages (H, ps, D); vs 16MB/core
+    lane_d = head_dim // 2 if kv_bits == 4 else head_dim
+    if lane_d % 128 != 0 or page_size % 32 != 0 or chunk % 8 != 0:
+        return False
+    # VMEM: q + acc (chunk, H, D) each, K/V pages (Hkv, ps, D); vs 16MB/core
     vmem = 4 * (2 * chunk * n_heads * head_dim
-                + 2 * n_heads * page_size * head_dim)
+                + 2 * nkv * page_size * head_dim)
     return vmem < 8 * 1024 * 1024
 
 
 def _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                      page_size, scale, chunk):
-    """The ONE online-softmax page step shared by the float and int8
+                      page_size, scale, chunk, window=None, n_kv=None):
+    """The ONE online-softmax page step shared by the float/int8/int4
     entries (only how k/v materialize in VMEM differs): init scratch on
     the first page, score + causal-mask this page against every chunk
-    row, fold into the m/l/acc flash recurrence, divide out on the last
-    page."""
+    row (GQA query heads regrouped over the shared KV head, never
+    repeating K/V; sliding window drops keys more than ``window`` behind
+    each row), fold into the m/l/acc flash recurrence, divide out on the
+    last page."""
     p = pl.program_id(0)
 
     @pl.when(p == 0)
@@ -82,21 +90,41 @@ def _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     q = q_ref[...].astype(jnp.float32)                     # (C, H, D)
-    s = jnp.einsum("chd,hsd->hcs", q, k,
-                   preferred_element_type=jnp.float32) * scale  # (H, C, ps)
+    c, h, d = q.shape
+    nkv = h if n_kv is None else n_kv
+    g = h // nkv
+    if g == 1:
+        s = jnp.einsum("chd,hsd->hcs", q, k,
+                       preferred_element_type=jnp.float32)  # (H, C, ps)
+    else:
+        qg = q.reshape(c, nkv, g, d)
+        s = jnp.einsum("cngd,nsd->ngcs", qg, k,
+                       preferred_element_type=jnp.float32) \
+            .reshape(h, c, page_size)
+    s = s * scale
     pos = p * jnp.int32(page_size) + jax.lax.broadcasted_iota(
         jnp.int32, (1, 1, page_size), 2)
     qpos = start_ref[0] + jax.lax.broadcasted_iota(
         jnp.int32, (1, chunk, 1), 1)
-    s = jnp.where(pos <= qpos, s, jnp.float32(_NEG_INF))
+    keep = pos <= qpos
+    if window is not None:
+        keep = keep & (pos > qpos - window)
+    s = jnp.where(keep, s, jnp.float32(_NEG_INF))
 
     m_prev = m_ref[...]                                    # (H, C)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
     alpha = jnp.exp(m_prev - m_new)
     pexp = jnp.exp(s - m_new[:, :, None])
     l_ref[...] = l_ref[...] * alpha + jnp.sum(pexp, axis=2)
-    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + jnp.einsum(
-        "hcs,hsd->hcd", pexp, v, preferred_element_type=jnp.float32)
+    if g == 1:
+        upd = jnp.einsum("hcs,hsd->hcd", pexp, v,
+                         preferred_element_type=jnp.float32)
+    else:
+        pg = pexp.reshape(nkv, g, c, page_size)
+        upd = jnp.einsum("ngcs,nsd->ngcd", pg, v,
+                         preferred_element_type=jnp.float32) \
+            .reshape(h, c, v.shape[-1])
+    acc_ref[...] = acc_ref[...] * alpha[:, :, None] + upd
     m_ref[...] = m_new
 
     @pl.when(p == pl.num_programs(0) - 1)
@@ -106,59 +134,81 @@ def _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
 
 
 def _prefill_kernel(bt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
-                    m_ref, l_ref, acc_ref, *, page_size, scale, chunk):
-    k = k_ref[0].astype(jnp.float32)                       # (H, ps, D)
+                    m_ref, l_ref, acc_ref, *, page_size, scale, chunk,
+                    window=None, n_kv=None):
+    k = k_ref[0].astype(jnp.float32)                       # (Hkv, ps, D)
     v = v_ref[0].astype(jnp.float32)
     _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                      page_size, scale, chunk)
+                      page_size, scale, chunk, window=window, n_kv=n_kv)
 
 
 # the int8 entry has its own arity (scale refs) but the same recurrence
 def _prefill_kernel_int8(bt_ref, start_ref, q_ref, k_ref, ks_ref, v_ref,
                          vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                         page_size, scale, chunk):
-    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (H, ps, D)
+                         page_size, scale, chunk, window=None, n_kv=None):
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0]           # (Hkv, ps, D)
     v = v_ref[0].astype(jnp.float32) * vs_ref[0]
     _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
-                      page_size, scale, chunk)
+                      page_size, scale, chunk, window=window, n_kv=n_kv)
+
+
+# int4 pages arrive nibble-packed (D//2 bytes per position); the unpack
+# happens in VMEM right after the page DMA — same decision as decode
+def _prefill_kernel_int4(bt_ref, start_ref, q_ref, k_ref, ks_ref, v_ref,
+                         vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                         page_size, scale, chunk, window=None, n_kv=None):
+    k = _unpack4_vmem(k_ref[0]) * ks_ref[0]                # (Hkv, ps, D)
+    v = _unpack4_vmem(v_ref[0]) * vs_ref[0]
+    _chunk_recurrence(start_ref, q_ref, k, v, o_ref, m_ref, l_ref, acc_ref,
+                      page_size, scale, chunk, window=window, n_kv=n_kv)
 
 
 def paged_prefill(q, k_pages, v_pages, block_table, start, *,
-                  k_scales=None, v_scales=None, scale=None,
+                  k_scales=None, v_scales=None, scale=None, window=None,
                   interpret: bool | None = None):
     """Chunk attention through a paged KV pool.
 
     ``q`` (C, H, D) float — the chunk's queries, row i at global position
-    ``start + i``; ``k_pages``/``v_pages`` (P, H, page_size, D) float —
-    or int8 with ``k_scales``/``v_scales`` (P, H, page_size, 1) fp32;
-    ``block_table`` (max_pages,) int32 page ids for THIS slot (padding
-    entries must reference a valid page — the pool's null page 0);
-    ``start`` scalar int32 positions already valid before the chunk.  The
-    chunk's own K/V must ALREADY be written into the pages.  Returns
-    (C, H, D) in q.dtype.  Callers gate on :func:`available` /
-    :func:`supported` first.
+    ``start + i``; ``k_pages``/``v_pages`` (P, Hkv, page_size, D) float —
+    Hkv may divide H (GQA) — or int8 with ``k_scales``/``v_scales``
+    (P, Hkv, page_size, 1) fp32, or nibble-packed int4 (last dim D//2)
+    with the same scale layout; ``block_table`` (max_pages,) int32 page
+    ids for THIS slot (padding entries must reference a valid page — the
+    pool's null page 0); ``start`` scalar int32 positions already valid
+    before the chunk; ``window`` optional sliding-window width — row i
+    sees positions ``(start + i - window, start + i]``.  The chunk's own
+    K/V must ALREADY be written into the pages.  Returns (C, H, D) in
+    q.dtype.  Callers gate on :func:`available` / :func:`supported`
+    first.
     """
     c, h, d = q.shape
-    _, _, ps, _ = k_pages.shape
+    _, hkv, ps, d_store = k_pages.shape
     max_pages = block_table.shape[0]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     scale = np.float32(scale)
     if interpret is None:
         interpret = not _backend_is_tpu()
-    int8 = k_scales is not None
+    quant = k_scales is not None
+    int4 = quant and d_store != d
+    nkv = None if hkv == h else hkv
+    win = None if window is None else int(window)
 
     q_spec = pl.BlockSpec((c, h, d), lambda p, bt, st: (0, 0, 0))
-    pg_spec = pl.BlockSpec((1, h, ps, d), lambda p, bt, st: (bt[p], 0, 0, 0))
-    sc_spec = pl.BlockSpec((1, h, ps, 1), lambda p, bt, st: (bt[p], 0, 0, 0))
-    if int8:
-        kernel = functools.partial(_prefill_kernel_int8, page_size=ps,
-                                   scale=scale, chunk=c)
+    pg_spec = pl.BlockSpec((1, hkv, ps, d_store),
+                           lambda p, bt, st: (bt[p], 0, 0, 0))
+    sc_spec = pl.BlockSpec((1, hkv, ps, 1),
+                           lambda p, bt, st: (bt[p], 0, 0, 0))
+    if quant:
+        body = _prefill_kernel_int4 if int4 else _prefill_kernel_int8
+        kernel = functools.partial(body, page_size=ps, scale=scale, chunk=c,
+                                   window=win, n_kv=nkv)
         in_specs = [q_spec, pg_spec, sc_spec, pg_spec, sc_spec]
         args = (q, k_pages, k_scales, v_pages, v_scales)
     else:
         kernel = functools.partial(_prefill_kernel, page_size=ps,
-                                   scale=scale, chunk=c)
+                                   scale=scale, chunk=c, window=win,
+                                   n_kv=nkv)
         in_specs = [q_spec, pg_spec, pg_spec]
         args = (q, k_pages, v_pages)
 
@@ -182,20 +232,32 @@ def paged_prefill(q, k_pages, v_pages, block_table, start, *,
 
 
 def paged_prefill_ref(q, k_pages, v_pages, block_table, start, *,
-                      k_scales=None, v_scales=None, scale=None):
+                      k_scales=None, v_scales=None, scale=None,
+                      window=None):
     """jnp reference path: gathers this slot's pages dense and runs the
     EXACT einsum/mask/softmax sequence of the dense prefill
     (models/generation._block_fwd) with the same causal rule
-    ``page_pos <= start + row``, so a chunked paged prefill is
+    ``page_pos <= start + row`` (and window lower bound) and the same
+    GQA grouping / dequant decisions, so a chunked paged prefill is
     bit-comparable to the monolithic dense prefill — the CPU fallback and
     the kernel's parity oracle."""
     c, h, d = q.shape
     ps = k_pages.shape[2]
+    hkv = k_pages.shape[1]
     s_max = block_table.shape[0] * ps
-    k_eff = gather_pages(k_pages, block_table[None], k_scales)[0]  # (H,S,D)
-    v_eff = gather_pages(v_pages, block_table[None], v_scales)[0]
-    s = jnp.einsum("chd,hsd->hcs", q, k_eff,
-                   preferred_element_type=jnp.float32)
+    k_eff = gather_pages(k_pages, block_table[None], k_scales,
+                         head_dim=d)[0]                    # (Hkv, S, D)
+    v_eff = gather_pages(v_pages, block_table[None], v_scales,
+                         head_dim=d)[0]
+    if h == hkv:
+        s = jnp.einsum("chd,hsd->hcs", q, k_eff,
+                       preferred_element_type=jnp.float32)
+        grouped = False
+    else:
+        qg = q.reshape(c, hkv, h // hkv, d)
+        s = jnp.einsum("cngd,nsd->ngcs", qg, k_eff,
+                       preferred_element_type=jnp.float32)
+        grouped = True
     if scale is None:
         # divide, exactly as the dense decoder scales its scores — keeps
         # the two prefill substrates bit-comparable, not just close
@@ -204,7 +266,14 @@ def paged_prefill_ref(q, k_pages, v_pages, block_table, start, *,
         s = s * jnp.float32(scale)
     pos = jnp.arange(s_max, dtype=jnp.int32)[None, None, :]
     qpos = start + jnp.arange(c, dtype=jnp.int32)[None, :, None]
-    s = jnp.where(pos <= qpos, s, _NEG_INF)
+    keep = pos <= qpos
+    if window is not None:
+        keep = keep & (pos > qpos - window)
+    s = jnp.where(keep[None] if grouped else keep, s, _NEG_INF)
     att = jax.nn.softmax(s, axis=-1).astype(v_eff.dtype)
-    out = jnp.einsum("hcs,hsd->chd", att, v_eff)
+    if grouped:
+        out = jnp.einsum("ngcs,nsd->cngd", att, v_eff) \
+            .reshape(c, h, v_eff.shape[-1])
+    else:
+        out = jnp.einsum("hcs,hsd->chd", att, v_eff)
     return out.astype(q.dtype)

@@ -37,19 +37,33 @@ from ..distributed import mesh as mesh_mod
 NEG_INF = -1e30
 
 
-def _ring_block(q, k, v, o, m, l, q_off, kv_off, scale, causal):
+def _ring_block(q, k, v, o, m, l, q_off, kv_off, scale, causal,
+                window=None):
     """Fold one visiting K/V block into the online-softmax accumulator.
 
-    q: (B, H, Sq, D); k/v: (B, H, Sk, D); o: like q (unnormalized);
-    m/l: (B, H, Sq) running max / normalizer.  Offsets are the blocks'
-    global sequence positions (traced scalars).
+    q: (B, H, Sq, D); k/v: (B, Hkv, Sk, D) with Hkv a divisor of H (GQA:
+    query-head groups share a K/V head via a reshape, no K/V repeat);
+    o: like q (unnormalized); m/l: (B, H, Sq) running max / normalizer.
+    Offsets are the blocks' global sequence positions (traced scalars).
+    ``window`` (causal only) hides keys older than ``window`` positions.
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    b, h, sq, _ = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    if h != hkv:
+        g = h // hkv
+        qg = q.reshape(b, hkv, g, sq, q.shape[-1])
+        s = jnp.einsum("bngqd,bnkd->bngqk", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(b, h, sq, sk)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
     if causal:
-        q_pos = q_off + jnp.arange(q.shape[-2])
-        kv_pos = kv_off + jnp.arange(k.shape[-2])
+        q_pos = q_off + jnp.arange(sq)
+        kv_pos = kv_off + jnp.arange(sk)
         mask = q_pos[:, None] >= kv_pos[None, :]
+        if window is not None:
+            mask = mask & (kv_pos[None, :] > q_pos[:, None] - window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     m_blk = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, m_blk)
@@ -58,14 +72,21 @@ def _ring_block(q, k, v, o, m, l, q_off, kv_off, scale, causal):
     p = jnp.exp(s - m_safe[..., None])  # masked scores underflow to 0
     alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
     l_new = alpha * l + jnp.sum(p, axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(p.dtype))
+    if h != hkv:
+        g = h // hkv
+        pg = p.reshape(b, hkv, g, sq, sk)
+        o_blk = jnp.einsum("bngqk,bnkd->bngqd", pg, v.astype(p.dtype))
+        o_blk = o_blk.reshape(b, h, sq, v.shape[-1])
+    else:
+        o_blk = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype))
+    o_new = o * alpha[..., None] + o_blk
     return o_new, m_new, l_new
 
 
 def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
                    scale: Optional[float] = None,
-                   use_flash: Optional[bool] = None, layout: str = "bnsd"):
+                   use_flash: Optional[bool] = None, layout: str = "bnsd",
+                   window: Optional[int] = None):
     """Attention over sequence-sharded Q/K/V (global arrays, (B, H, S, D)).
 
     The sequence dim is (re)sharded over ``axis``; returns the global
@@ -89,7 +110,15 @@ def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
         # single chip: the sdpa dispatcher picks the flash kernel on TPU
         from .attention import sdpa
 
-        return sdpa(q, k, v, scale=scale, is_causal=causal, layout=layout)
+        return sdpa(q, k, v, scale=scale, is_causal=causal, layout=layout,
+                    window=window)
+    h_axis = 2 if layout == "sbnd" else 1
+    grouped = q.shape[h_axis] != k.shape[h_axis]
+    if grouped or window is not None:
+        # the flash ring composition merges heads into the flat (bh, s, d)
+        # block engine and gates visiting blocks whole — GQA grouping and
+        # the window's partial-block masking both live in the einsum engine
+        use_flash = False
     if use_flash is None:
         from . import flash as _fl
 
@@ -131,7 +160,7 @@ def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
             o, m, l, k_r, v_r = carry
             kv_off = ((i - r) % ring) * s_local
             o, m, l = _ring_block(ql, k_r, v_r, o, m, l, q_off, kv_off,
-                                  scale, causal)
+                                  scale, causal, window=window)
             # rotate AFTER using the block; XLA overlaps this ppermute with
             # the next iteration's einsum
             k_r = lax.ppermute(k_r, axis, perm)
@@ -166,7 +195,8 @@ def ring_attention(q, k, v, axis: str = "mp", causal: bool = False,
 def ring_flash_attention(q, k, v, axis: str = "mp", causal: bool = False,
                          scale: Optional[float] = None,
                          interpret: Optional[bool] = None,
-                         layout: str = "bnsd"):
+                         layout: str = "bnsd",
+                         window: Optional[int] = None):
     """Ring attention whose per-device block engine is the Pallas flash
     kernel (kernels/flash.py) instead of the einsum online-softmax.
 
@@ -188,7 +218,13 @@ def ring_flash_attention(q, k, v, axis: str = "mp", causal: bool = False,
     if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
         from .attention import sdpa
 
-        return sdpa(q, k, v, scale=scale, is_causal=causal, layout=layout)
+        return sdpa(q, k, v, scale=scale, is_causal=causal, layout=layout,
+                    window=window)
+    h_axis = 2 if layout == "sbnd" else 1
+    if q.shape[h_axis] != k.shape[h_axis] or window is not None:
+        return ring_attention(q, k, v, axis=axis, causal=causal,
+                              scale=scale, use_flash=False, layout=layout,
+                              window=window)
     ring = int(mesh.shape[axis])
     seq_first = layout == "sbnd"
     if seq_first:

@@ -83,43 +83,66 @@ def _kv_quant(blk):
     return quantize_per_token(blk)
 
 
+def _kv_quant4(blk):
+    """Symmetric int4 over the head dim: [..., D] -> (packed int8
+    [..., D//2] nibbles, fp32 scale [..., 1]) — the shared int4 per-token
+    rule (ops/quant_ops.quantize_int4_per_token), so the dense cache and
+    the paged pool quantize identically."""
+    from ..ops.quant_ops import quantize_int4_per_token
+
+    return quantize_int4_per_token(blk)
+
+
+def _kv_dequant(vals, scale, hd):
+    """Dequantize a quantized cache side: int4 nibble caches (packed last
+    dim == hd // 2) unpack in the same expression XLA fuses into the
+    attention einsum; int8 caches multiply straight through."""
+    if vals.shape[-1] != hd:
+        from ..ops.quant_ops import unpack_int4
+
+        return unpack_int4(vals).astype(jnp.float32) * scale
+    return vals.astype(jnp.float32) * scale
+
+
 def _ln(x, g, b, eps):
     mu = x.mean(-1, keepdims=True)
     var = x.var(-1, keepdims=True)
     return (x - mu) / jnp.sqrt(var + eps) * g + b
 
 
-def _block_qkv(p, x, n_heads, eps, seq_major=False):
+def _block_qkv(p, x, n_heads, eps, seq_major=False, n_kv_heads=None):
     """The block's pre-attention half: LN1 + fused QKV projection + head
     split.  Returns ``(q, k_blk, v_blk)`` with ``k_blk``/``v_blk`` in the
-    cache's (B, H, T, D) layout and ``q`` in the layout the attention
+    cache's (B, Hkv, T, D) layout and ``q`` in the layout the attention
     einsum of the caller's path wants ((T, B, H, D) seq-major, else
-    (B, H, T, D)).  Shared by the dense-cache decoder below and the
-    paged-cache serving engine (serving/engine.py) so the two decode
-    substrates cannot fork numerically."""
+    (B, H, T, D)).  Under GQA the fused projection is (H + 2*Hkv)*D wide
+    and the split is uneven — K/V carry only ``n_kv_heads`` heads.  Shared
+    by the dense-cache decoder below and the paged-cache serving engine
+    (serving/engine.py) so the two decode substrates cannot fork
+    numerically."""
     if seq_major:
         t, b, h = x.shape
     else:
         b, t, h = x.shape
     hd = h // n_heads
+    nkv = n_heads if n_kv_heads is None else n_kv_heads
     hx = _ln(x, p["ln1_g"], p["ln1_b"], eps)
     qkv = _mm(p, "qkv", hx) + p["qkv_b"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [n_heads * hd, (n_heads + nkv) * hd], axis=-1)
 
     if seq_major:
-        def heads(z):  # (T, B, h) -> (T, B, H, hd)
-            return z.reshape(t, b, n_heads, hd)
-
-        q, k, v = heads(q), heads(k), heads(v)
+        q = q.reshape(t, b, n_heads, hd)
+        k = k.reshape(t, b, nkv, hd)
+        v = v.reshape(t, b, nkv, hd)
         # cache blocks are tiny in decode (T=1): einsum to the cache layout
         k_blk = jnp.einsum("tbhd->bhtd", k)
         v_blk = jnp.einsum("tbhd->bhtd", v)
     else:
-        def heads(z):  # (B, T, h) -> (B, H, T, hd)
-            return z.reshape(b, t, n_heads, hd).transpose(0, 2, 1, 3)
+        def heads(z, n):  # (B, T, n*hd) -> (B, n, T, hd)
+            return z.reshape(b, t, n, hd).transpose(0, 2, 1, 3)
 
-        q, k, v = heads(q), heads(k), heads(v)
-        k_blk, v_blk = k, v
+        q = heads(q, n_heads)
+        k_blk, v_blk = heads(k, nkv), heads(v, nkv)
     return q, k_blk, v_blk
 
 
@@ -141,18 +164,24 @@ def _block_finish(p, x, out, eps):
                                          approximate=False)) + p["fc2_b"]
 
 
-def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
+def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False,
+               n_kv_heads=None, window=None):
     """One decoder block over ``x`` with cache write at ``pos``.
 
     ``x`` is (B, T, h) batch-major or (T, B, h) when ``seq_major`` — the
     model's [S, B, H] activation layout (GPTConfig.seq_major).  The KV cache
-    keeps its (B, H, S, D) layout in both modes; the attention einsums
-    consume/produce the seq-major activations in place.  An int8 cache
-    arrives as a ``(values int8, scales fp32)`` tuple per side; the new
+    keeps its (B, Hkv, S, D) layout in both modes (Hkv < H under GQA; the
+    attention einsums group query heads over the shared K/V head by a
+    reshape, never by repeating the cache); the attention einsums
+    consume/produce the seq-major activations in place.  A quantized cache
+    arrives as a ``(values, scales)`` tuple per side — int8 values, or
+    packed int4 nibbles (last dim D//2, detected from the shape); the new
     K/V block is quantized at the write and the whole cache dequantizes
     INSIDE the attention einsum's producer (XLA fuses the elementwise
-    dequant into the dot), so HBM only ever streams int8 values + one
-    fp32 scale per (b, h, position).
+    dequant/unpack into the dot), so HBM only ever streams the quantized
+    values + one fp32 scale per (b, h, position).  ``window`` applies
+    causal sliding-window masking: each query sees only the trailing
+    ``window`` positions.
 
     Works for prefill (T = prompt len, pos = 0) and decode (T = 1,
     pos = current length).  Returns (y, k_cache, v_cache)."""
@@ -161,35 +190,60 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
     else:
         b, t, h = x.shape
     hd = h // n_heads
-    q, k_blk, v_blk = _block_qkv(p, x, n_heads, eps, seq_major=seq_major)
-    int8_kv = isinstance(k_cache, tuple)
-    if int8_kv:
+    nkv = n_heads if n_kv_heads is None else n_kv_heads
+    q, k_blk, v_blk = _block_qkv(p, x, n_heads, eps, seq_major=seq_major,
+                                 n_kv_heads=n_kv_heads)
+    quant_kv = isinstance(k_cache, tuple)
+    if quant_kv:
         kq, ksc = k_cache
         vq, vsc = v_cache
-        k_q, k_s = _kv_quant(k_blk)
-        v_q, v_s = _kv_quant(v_blk)
+        int4_kv = kq.shape[-1] != hd
+        quant = _kv_quant4 if int4_kv else _kv_quant
+        k_q, k_s = quant(k_blk)
+        v_q, v_s = quant(v_blk)
         kq = lax.dynamic_update_slice(kq, k_q, (0, 0, pos, 0))
         ksc = lax.dynamic_update_slice(ksc, k_s, (0, 0, pos, 0))
         vq = lax.dynamic_update_slice(vq, v_q, (0, 0, pos, 0))
         vsc = lax.dynamic_update_slice(vsc, v_s, (0, 0, pos, 0))
         k_cache, v_cache = (kq, ksc), (vq, vsc)
-        k_eff = kq.astype(jnp.float32) * ksc
-        v_eff = vq.astype(jnp.float32) * vsc
+        k_eff = _kv_dequant(kq, ksc, hd)
+        v_eff = _kv_dequant(vq, vsc, hd)
     else:
         k_cache = lax.dynamic_update_slice(k_cache, k_blk, (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v_blk, (0, 0, pos, 0))
         k_eff, v_eff = k_cache, v_cache
     s_max = k_eff.shape[2]
-    scores = jnp.einsum("tbhd,bhsd->bhts" if seq_major else "bhtd,bhsd->bhts",
-                        q, k_eff, preferred_element_type=jnp.float32)
+    grouped = nkv != n_heads
+    if grouped:
+        g = n_heads // nkv
+        qg = (q.reshape(t, b, nkv, g, hd) if seq_major
+              else q.reshape(b, nkv, g, t, hd))
+        scores = jnp.einsum(
+            "tbngd,bnsd->bngts" if seq_major else "bngtd,bnsd->bngts",
+            qg, k_eff, preferred_element_type=jnp.float32)
+    else:
+        scores = jnp.einsum(
+            "tbhd,bhsd->bhts" if seq_major else "bhtd,bhsd->bhts",
+            q, k_eff, preferred_element_type=jnp.float32)
     scores = scores / np.sqrt(hd).astype(np.float32)
     # causal + cache-validity mask over global positions
     q_pos = pos + jnp.arange(t)[:, None]
     kv_pos = jnp.arange(s_max)[None, :]
     mask = kv_pos <= q_pos
-    scores = jnp.where(mask[None, None], scores, -1e30)
+    if window is not None:
+        mask = mask & (kv_pos > q_pos - window)
+    bmask = mask[None, None, None] if grouped else mask[None, None]
+    scores = jnp.where(bmask, scores, -1e30)
     att = jax.nn.softmax(scores, axis=-1).astype(v_eff.dtype)
-    if seq_major:
+    if grouped:
+        if seq_major:
+            out = jnp.einsum("bngts,bnsd->tbngd", att, v_eff) \
+                .reshape(t, b, h)
+        else:
+            out = jnp.einsum("bngts,bnsd->bngtd", att, v_eff) \
+                .reshape(b, n_heads, t, hd)
+            out = out.transpose(0, 2, 1, 3).reshape(b, t, h)
+    elif seq_major:
         out = jnp.einsum("bhts,bhsd->tbhd", att, v_eff).reshape(t, b, h)
     else:
         out = jnp.einsum("bhts,bhsd->bhtd", att, v_eff)
@@ -198,7 +252,21 @@ def _block_fwd(p, x, k_cache, v_cache, pos, n_heads, eps, seq_major=False):
     return _block_finish(p, x, out, eps), k_cache, v_cache
 
 
-def _decoder_setup(model, int8=None):
+def _resolve_kv_bits(cfg, int8, kv_bits=None):
+    """Effective KV-cache quantization width: an explicit ``kv_bits``
+    override wins, then ``cfg.kv_bits``, then the legacy coupling where
+    ``int8`` (W8A8 weights) also selects an int8 cache.  Returns
+    None / 8 / 4."""
+    if kv_bits is None:
+        kv_bits = getattr(cfg, "kv_bits", None)
+    if kv_bits is None and int8:
+        kv_bits = 8
+    if kv_bits not in (None, 4, 8):
+        raise ValueError(f"kv_bits must be None, 4 or 8, got {kv_bits!r}")
+    return kv_bits
+
+
+def _decoder_setup(model, int8=None, attn_window=None):
     """Shared decode substrate for greedy/sampling and beam search:
     returns ``(params, make_run, int8)`` — the flat param pytree, a
     ``make_run(p)`` producing the cached forward ``run(tokens, pos, kc,
@@ -216,6 +284,9 @@ def _decoder_setup(model, int8=None):
     gpt = model.gpt
     eps = cfg.layer_norm_eps
     n_heads = cfg.num_heads
+    n_kv_heads = getattr(cfg, "num_kv_heads", None) or n_heads
+    window = (attn_window if attn_window is not None
+              else getattr(cfg, "attn_window", None))
     seq_major = bool(getattr(cfg, "seq_major", False))
     params = {
         "wte": gpt.embeddings.word_embeddings.weight._array,
@@ -242,7 +313,8 @@ def _decoder_setup(model, int8=None):
                 # (values, scales) tuple caches thread the same code path
                 x, k1, v1 = _block_fwd(bp, x, _tree_map(lambda a: a[li], kc),
                                        _tree_map(lambda a: a[li], vc), pos,
-                                       n_heads, eps, seq_major=seq_major)
+                                       n_heads, eps, seq_major=seq_major,
+                                       n_kv_heads=n_kv_heads, window=window)
                 new_k.append(k1)
                 new_v.append(v1)
             logits = logits_from(x)
@@ -257,12 +329,16 @@ def _decoder_setup(model, int8=None):
     return params, make_run, int8
 
 
-def _empty_cache(cfg, b, s_max, dtype, int8=False):
+def _empty_cache(cfg, b, s_max, dtype, int8=False, kv_bits=None):
     hd = cfg.hidden_size // cfg.num_heads
-    shape = (cfg.num_layers, b, cfg.num_heads, s_max, hd)
-    if int8:
+    nkv = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+    kv_bits = _resolve_kv_bits(cfg, int8, kv_bits)
+    shape = (cfg.num_layers, b, nkv, s_max, hd)
+    if kv_bits is not None:
+        vd = hd // 2 if kv_bits == 4 else hd  # int4: two nibbles per byte
+
         def side():
-            return (jnp.zeros(shape, jnp.int8),
+            return (jnp.zeros(shape[:-1] + (vd,), jnp.int8),
                     jnp.zeros(shape[:-1] + (1,), jnp.float32))
 
         return side(), side()
@@ -335,7 +411,9 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
                       top_k: int = 0, greedy: bool = True,
                       top_p: float = 1.0,
                       eos_token_id: Optional[int] = None,
-                      int8: Optional[bool] = None):
+                      int8: Optional[bool] = None,
+                      kv_bits: Optional[int] = None,
+                      attn_window: Optional[int] = None):
     """Compile ``(ids, seed) -> generated ids`` for a GPTForPretraining.
 
     Returns ``gen(ids)`` taking a (B, prompt_len) int array and returning
@@ -346,16 +424,20 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
     early-stop — the scan still runs ``max_new_tokens`` steps, shapes are
     static, but finished rows stop changing).  ``int8`` (default:
     ``cfg.int8``) selects W8A8 projections + an int8 KV cache.
+    ``kv_bits`` (default ``cfg.kv_bits``; 8 or 4) quantizes only the KV
+    cache — 4 packs two nibbles per byte; ``attn_window`` (default
+    ``cfg.attn_window``) applies causal sliding-window attention.
     """
     cfg = model.cfg
-    params, make_run, int8 = _decoder_setup(model, int8=int8)
+    params, make_run, int8 = _decoder_setup(model, int8=int8,
+                                            attn_window=attn_window)
     sample = _make_sampler(greedy, temperature, top_k, top_p)
 
     @functools.partial(jax.jit, static_argnums=())
     def gen(p, ids, seed):
         b, t0 = ids.shape
         kc, vc = _empty_cache(cfg, b, t0 + max_new_tokens, p["wte"].dtype,
-                              int8=int8)
+                              int8=int8, kv_bits=kv_bits)
         run = make_run(p)
         logits, kc, vc = run(ids, 0, kc, vc)
         key = jax.random.PRNGKey(seed)
@@ -394,13 +476,15 @@ def build_generate_fn(model, max_new_tokens: int, temperature: float = 1.0,
 def generate(model, ids, max_new_tokens: int = 32, temperature: float = 1.0,
              top_k: int = 0, greedy: bool = True, seed: int = 0,
              top_p: float = 1.0, eos_token_id: Optional[int] = None,
-             int8: Optional[bool] = None):
+             int8: Optional[bool] = None, kv_bits: Optional[int] = None,
+             attn_window: Optional[int] = None):
     """Convenience one-shot API (compiles per (shape, knobs))."""
     from ..dygraph.tensor import Tensor
 
     arr = ids._array if isinstance(ids, Tensor) else np.asarray(ids)
     fn = build_generate_fn(model, max_new_tokens, temperature, top_k, greedy,
-                           top_p=top_p, eos_token_id=eos_token_id, int8=int8)
+                           top_p=top_p, eos_token_id=eos_token_id, int8=int8,
+                           kv_bits=kv_bits, attn_window=attn_window)
     out = fn(arr, seed)
     return Tensor(out, stop_gradient=True) if isinstance(ids, Tensor) else out
 

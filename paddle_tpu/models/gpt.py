@@ -58,10 +58,39 @@ class GPTConfig:
     # eager forward (the functional train step's chunked-CE head stays
     # float: the 50k-vocab logits are numerically the loss-critical path)
     int8_lm_head: bool = False
+    # num_kv_heads < num_heads = grouped-query attention (GQA, Ainslie et
+    # al.): the QKV projection emits only num_kv_heads K/V heads
+    # ((num_heads + 2*num_kv_heads) * head_dim wide instead of 3*hidden)
+    # and every attention entry gathers query heads per group INSIDE the
+    # kernel — K/V are never repeated to num_heads in HBM, so the decode
+    # KV cache and the serving page pool shrink by the group factor.
+    # None = num_heads (MHA, the pre-GQA layout, bit-identical).
+    num_kv_heads: Optional[int] = None
+    # attn_window: sliding-window causal attention (Mistral 7B) — position
+    # p attends [p-attn_window+1, p].  Serving recycles KV pages behind
+    # the window so long generations stop growing.  None = full causal.
+    attn_window: Optional[int] = None
+    # kv_bits: decode-time KV cache precision — None stores the model
+    # dtype, 8 the per-token int8 layout (also implied by ``int8``), 4
+    # packs two nibbles per byte with the same per-position fp32 scales
+    # (ops/quant_ops.quantize_int4_per_token), halving KV bytes again.
+    # Training numerics are untouched; only generation/serving caches read
+    # this knob.
+    kv_bits: Optional[int] = None
 
     def __post_init__(self):
         if self.ffn_hidden is None:
             self.ffn_hidden = 4 * self.hidden_size
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be a multiple of "
+                f"num_kv_heads ({self.num_kv_heads})")
+        if self.attn_window is not None and self.attn_window < 1:
+            raise ValueError(f"attn_window must be >= 1, got {self.attn_window}")
+        if self.kv_bits not in (None, 4, 8):
+            raise ValueError(f"kv_bits must be None, 8 or 4, got {self.kv_bits}")
 
 
 def gpt_tiny(**kw):
@@ -111,23 +140,30 @@ class GPTAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.num_heads = cfg.num_heads
+        self.num_kv_heads = cfg.num_kv_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.window = cfg.attn_window
         self.dropout = cfg.dropout
         self.seq_major = cfg.seq_major
         self.int8 = cfg.int8
         init = nn.initializer.Normal(0.0, cfg.initializer_range)
         wa = nn.ParamAttr(initializer=init)
+        # GQA shrinks the fused projection: [q (H heads) | k | v (Hkv heads
+        # each)] — split by GLOBAL widths below, which stays correct under
+        # TP because GSPMD arrays are logically global (the column-sharded
+        # projection output carries its 'mp' sharding through the split)
+        qkv_width = (cfg.num_heads + 2 * cfg.num_kv_heads) * self.head_dim
         if cfg.use_parallel:
             from ..distributed.fleet import meta_parallel as mpp
 
             self.qkv = mpp.ColumnParallelLinear(
-                cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=wa,
+                cfg.hidden_size, qkv_width, weight_attr=wa,
                 gather_output=False)
             self.proj = mpp.RowParallelLinear(
                 cfg.hidden_size, cfg.hidden_size, weight_attr=wa,
                 input_is_parallel=True)
         else:
-            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=wa)
+            self.qkv = nn.Linear(cfg.hidden_size, qkv_width, weight_attr=wa)
             self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size, weight_attr=wa)
 
     def _run_qkv(self, x):
@@ -137,25 +173,32 @@ class GPTAttention(nn.Layer):
         return w8a8_linear(x, self.proj) if self.int8 else self.proj(x)
 
     def forward(self, x):
+        hd = self.head_dim
         if self.seq_major:
             # [S, B, H] in, [S, B, H] out — q/k/v reach the kernel through
             # reshapes and last-dim slices only (NO transposes; the sbnd
-            # kernel entry consumes the layout in place)
+            # kernel entry consumes the layout in place, and GQA only
+            # changes the split widths — K/V stay num_kv_heads wide all the
+            # way into the kernel)
             s, b, h = x.shape
             qkv = self._run_qkv(x)
-            local_h = qkv.shape[-1] // 3
-            nh = local_h // self.head_dim
-            q, k, v = T.split(qkv, 3, axis=-1)
-            shp = [s, b, nh, self.head_dim]
+            w = qkv.shape[-1]
+            nkv = self.num_kv_heads * w // (
+                (self.num_heads + 2 * self.num_kv_heads) * hd)
+            nh = (w - 2 * nkv * hd) // hd
+            q, k, v = T.split(qkv, [nh * hd, nkv * hd, nkv * hd], axis=-1)
             out = F.scaled_dot_product_attention(
-                T.reshape(q, shp), T.reshape(k, shp), T.reshape(v, shp),
+                T.reshape(q, [s, b, nh, hd]), T.reshape(k, [s, b, nkv, hd]),
+                T.reshape(v, [s, b, nkv, hd]),
                 is_causal=True, dropout_p=self.dropout,
-                training=self.training, layout="sbnd")
-            return self._run_proj(T.reshape(out, [s, b, local_h]))
+                training=self.training, layout="sbnd", window=self.window)
+            return self._run_proj(T.reshape(out, [s, b, nh * hd]))
         b, s, h = x.shape
         qkv = self._run_qkv(x)
-        local_h = qkv.shape[-1] // 3
-        nh = local_h // self.head_dim
+        w = qkv.shape[-1]
+        nkv = self.num_kv_heads * w // (
+            (self.num_heads + 2 * self.num_kv_heads) * hd)
+        nh = (w - 2 * nkv * hd) // hd
         # measured (flagship, v5e): the [b,nh,s,hd] transposes around the
         # flash call cost ~34ms/step, but the seq-major kernel variant
         # (layout="bsnd", kernels/flash._fwd_call_smajor) loses MORE to
@@ -163,12 +206,14 @@ class GPTAttention(nn.Layer):
         # tiles + XLA transposes win, so batch-major stays bnsd; the
         # END-TO-END seq-major layout is cfg.seq_major (the [S, B, H] branch
         # above), which removes the transposes without restriding K/V.
-        qkv = T.reshape(qkv, [b, s, 3, nh, self.head_dim])
-        qkv = T.transpose(qkv, [2, 0, 3, 1, 4])  # [3, b, nh, s, hd]
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        q, k, v = T.split(qkv, [nh * hd, nkv * hd, nkv * hd], axis=-1)
+        q = T.transpose(T.reshape(q, [b, s, nh, hd]), [0, 2, 1, 3])
+        k = T.transpose(T.reshape(k, [b, s, nkv, hd]), [0, 2, 1, 3])
+        v = T.transpose(T.reshape(v, [b, s, nkv, hd]), [0, 2, 1, 3])
         out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout, training=self.training)
-        out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, local_h])
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training, window=self.window)
+        out = T.reshape(T.transpose(out, [0, 2, 1, 3]), [b, s, nh * hd])
         return self._run_proj(out)
 
 

@@ -609,17 +609,20 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None,
-                                 layout="bnsd"):
+                                 layout="bnsd", window=None):
     """TPU fast path: routes to the fused attention kernel (Pallas when
     available, XLA-fused otherwise).  Beyond-parity: the reference only has
     multihead_matmul fusion for inference (operators/fused/multihead_matmul_op.cu).
     ``layout="bsnd"`` consumes [b, seq, heads, dim] seq-major in place (no
-    transposes around the kernel) — the layout paddle's own 2.3+ sdpa uses."""
+    transposes around the kernel) — the layout paddle's own 2.3+ sdpa uses.
+    K/V with fewer heads than Q select grouped-query attention (query heads
+    gathered per group inside the kernel); ``window`` restricts the causal
+    mask to the trailing ``window`` positions (sliding-window attention)."""
     from ...kernels import attention as attn_k
 
     return attn_k.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
-        is_causal=is_causal, training=training, layout=layout,
+        is_causal=is_causal, training=training, layout=layout, window=window,
     )
 
 

@@ -231,6 +231,56 @@ def quantize_per_token(x):
     return xq, sx
 
 
+_QMAX4 = 7.0
+
+
+def pack_int4(q):
+    """Pack an int8 array of int4 values (last dim even) two-per-byte,
+    SPLIT-HALVES layout: ``byte[i] = (q[i] & 0xF) | (q[i + D/2] << 4)``
+    — low nibbles hold the first half of the last dim, high nibbles the
+    second half.  (Halves, not interleaved: the inverse is then a lane
+    CONCATENATION, which Mosaic lowers where an interleaving lane reshape
+    does not — the paged kernels unpack in VMEM.)  Output last dim
+    halves.  Pure jnp — the serving import guard admits it into the
+    engine."""
+    d2 = q.shape[-1] // 2
+    lo = q[..., :d2].astype(jnp.int32) & 0xF
+    hi = q[..., d2:].astype(jnp.int32) & 0xF
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed):
+    """Inverse of :func:`pack_int4`: int8 bytes -> int8 int4 values with
+    the last dim doubled.  Sign-extends each nibble arithmetically
+    (``(b << 28) >> 28`` on the int32 widening), then concatenates the
+    low-nibble half before the high-nibble half — the SAME sequence the
+    paged kernels run in VMEM right after the page DMA, so dense and
+    paged int4 dequant decisions cannot fork."""
+    b = packed.astype(jnp.int32)
+    lo = ((b & 0xF) << 28) >> 28
+    hi = ((b >> 4) << 28) >> 28
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.int8)
+
+
+def quantize_int4_per_token(x):
+    """Dynamic symmetric per-token int4 KV quantization: (packed int8
+    [..., D/2], scale fp32 [..., 1] with ``scale = max(absmax, eps)/7``).
+    The int4 extension of :func:`quantize_per_token` — same per-position
+    scale layout (one fp32 per token), values packed two nibbles per byte
+    by :func:`pack_int4`.  THE single int4 KV quantization decision shared
+    by the dense decode cache and the paged pool."""
+    xf = x.astype(jnp.float32)
+    sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                     _EPS) / _QMAX4
+    xq = jnp.clip(jnp.round(xf / sx), -_QMAX4, _QMAX4).astype(jnp.int8)
+    return pack_int4(xq), sx
+
+
+def dequantize_int4(packed, scale):
+    """Dequantize :func:`quantize_int4_per_token` output back to fp32."""
+    return unpack_int4(packed).astype(jnp.float32) * scale
+
+
 def quantize_per_channel(w, axis: int = 1):
     """Symmetric per-output-channel int8 weight quantization.
 

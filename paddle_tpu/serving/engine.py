@@ -93,6 +93,7 @@ from ..models.generation import (
     _decoder_setup,
     _lm_head,
     _make_sampler,
+    _resolve_kv_bits,
     spec_accept_greedy,
 )
 from ..kernels import paged_attention as pa
@@ -158,6 +159,12 @@ class _Slot:
         #                               never a preemption victim)
         self.base_len = base_len      # work-prompt length at admission
         self.prefilled = prefilled    # work positions with K/V in pages
+        # high-water LOGICAL page count — how many block-table entries
+        # have ever been populated.  Without a window it always equals
+        # len(pages); windowed recycling frees dead leading pages (their
+        # table entries become the null page) so len(pages) shrinks while
+        # hw_pages keeps marking where the next growth appends
+        self.hw_pages = len(pages)
         self.started = False          # first token sampled; decoding
         # speculative draft buffer (r13): host-only, overwritten by every
         # spec step's fresh proposal — reconstructible from the request
@@ -247,7 +254,9 @@ class ServingEngine:
                  metrics=None, trace=None,
                  policy=None, tenants=None,
                  on_token: Optional[Callable[[int, int], None]] = None,
-                 spec_k: int = 0, spec_ngram: int = 3, drafter=None):
+                 spec_k: int = 0, spec_ngram: int = 3, drafter=None,
+                 kv_bits: Optional[int] = None,
+                 attn_window: Optional[int] = None):
         cfg = model.cfg
         self.cfg = cfg
         # decode_block > 1 fuses that many decode steps into ONE dispatched
@@ -280,8 +289,20 @@ class ServingEngine:
             if self.spec_k else None)
         self.params, _, self.int8 = _decoder_setup(model, int8=int8)
         self.n_heads = cfg.num_heads
+        self.n_kv_heads = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.eps = cfg.layer_norm_eps
+        # KV-capacity knobs (this PR): kv_bits / attn_window override the
+        # model config's defaults; the resolved values fix the pool's page
+        # layout and every attention dispatch's masking for the engine's
+        # whole lifetime (snapshot v5 records them; restore refuses a
+        # mismatched layout)
+        self.kv_bits = _resolve_kv_bits(cfg, self.int8, kv_bits)
+        win = attn_window if attn_window is not None \
+            else getattr(cfg, "attn_window", None)
+        if win is not None and int(win) < 1:
+            raise ValueError(f"attn_window must be >= 1, got {win}")
+        self.window = None if win is None else int(win)
         self.max_slots = max_slots
         self.page_size = page_size
         self.max_seq_len = max_seq_len or cfg.max_seq_len
@@ -301,8 +322,10 @@ class ServingEngine:
         dtype = self.params["wte"].dtype
         n_pages = num_pages or (1 + max_slots * self.max_pages)
         self.pool = KVPool(cfg.num_layers, cfg.num_heads, self.head_dim,
-                           n_pages, page_size, dtype=dtype, int8=self.int8,
-                           prefix_cache=prefix_cache)
+                           n_pages, page_size, dtype=dtype,
+                           prefix_cache=prefix_cache,
+                           num_kv_heads=self.n_kv_heads,
+                           kv_bits=self.kv_bits, window=self.window)
         self.pool.faults = faults
         self.scheduler = FCFSScheduler(max_slots, self.pool,
                                        token_budget=token_budget,
@@ -318,11 +341,14 @@ class ServingEngine:
         self._sample = _make_sampler(greedy, temperature, top_k, top_p)
         if use_paged_kernel is None:
             self._use_kernel = pa.available() and pa.supported(
-                cfg.num_heads, page_size, self.head_dim)
+                cfg.num_heads, page_size, self.head_dim,
+                n_kv_heads=self.n_kv_heads, kv_bits=self.kv_bits)
             self._use_prefill_kernel = pp.available() and pp.supported(
-                cfg.num_heads, page_size, self.head_dim, self.chunk_tokens)
+                cfg.num_heads, page_size, self.head_dim, self.chunk_tokens,
+                n_kv_heads=self.n_kv_heads, kv_bits=self.kv_bits)
             self._use_spec_kernel = pa.available() and pa.supported_mq(
-                cfg.num_heads, page_size, self.head_dim, self.spec_k + 1)
+                cfg.num_heads, page_size, self.head_dim, self.spec_k + 1,
+                n_kv_heads=self.n_kv_heads, kv_bits=self.kv_bits)
         else:
             self._use_kernel = bool(use_paged_kernel)
             self._use_prefill_kernel = bool(use_paged_kernel)
@@ -340,6 +366,10 @@ class ServingEngine:
             decode_block=decode_block, use_paged_kernel=use_paged_kernel,
             chunk_tokens=chunk_tokens, prefix_cache=prefix_cache,
             max_queue=max_queue,
+            # resolved KV layout knobs (not the raw ctor args): a restored
+            # engine must land on the SAME page layout whatever the model
+            # config defaults were at snapshot time
+            kv_bits=self.kv_bits, attn_window=self.window,
             # spec_k/spec_ngram rebuild the NGramDrafter at restore; a
             # custom drafter instance is like faults/clock — not
             # snapshot-portable (draft buffers themselves are transient
@@ -410,35 +440,41 @@ class ServingEngine:
 
     def _attend(self, q, bufs, li, table, lengths):
         """Paged decode attention for layer ``li`` — kernel or jnp ref."""
-        if self.int8:
+        if self.kv_bits is not None:
             kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
         else:
             kw = {}
         fn = pa.paged_attention if self._use_kernel else pa.paged_attention_ref
-        return fn(q, bufs["k"][li], bufs["v"][li], table, lengths, **kw)
+        return fn(q, bufs["k"][li], bufs["v"][li], table, lengths,
+                  window=self.window, **kw)
 
     def _attend_prefill(self, q, bufs, li, table_row, start):
         """Paged chunk attention for layer ``li`` — kernel or jnp ref."""
-        if self.int8:
+        if self.kv_bits is not None:
             kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
         else:
             kw = {}
         fn = (pp.paged_prefill if self._use_prefill_kernel
               else pp.paged_prefill_ref)
-        return fn(q, bufs["k"][li], bufs["v"][li], table_row, start, **kw)
+        return fn(q, bufs["k"][li], bufs["v"][li], table_row, start,
+                  window=self.window, **kw)
 
     def _scatter_kv(self, bufs, li, rows, offs, k1, v1):
-        """Write per-token K/V (rows of shape (N, H, D)) into layer ``li``
-        of the page pool at (page ``rows[i]``, offset ``offs[i]``) —
-        quantizing to int8 pages + fp32 per-token scales when serving
-        int8.  The ONE scatter/quantize sequence shared by the decode and
-        chunk-prefill programs, so the exact-parity contract cannot fork
-        between them."""
-        if self.int8:
-            from ..ops.quant_ops import quantize_per_token
+        """Write per-token K/V (rows of shape (N, Hkv, D)) into layer
+        ``li`` of the page pool at (page ``rows[i]``, offset ``offs[i]``)
+        — quantizing to int8 (or nibble-packed int4) pages + fp32
+        per-token scales when serving quantized KV.  The ONE
+        scatter/quantize sequence shared by the decode and chunk-prefill
+        programs, so the exact-parity contract cannot fork between
+        them."""
+        if self.kv_bits is not None:
+            from ..ops.quant_ops import (quantize_int4_per_token,
+                                         quantize_per_token)
 
-            kq, ksc = quantize_per_token(k1)
-            vq, vsc = quantize_per_token(v1)
+            qf = (quantize_int4_per_token if self.kv_bits == 4
+                  else quantize_per_token)
+            kq, ksc = qf(k1)
+            vq, vsc = qf(v1)
             bufs["k"] = bufs["k"].at[li, rows, :, offs, :].set(kq)
             bufs["ks"] = bufs["ks"].at[li, rows, :, offs, :].set(ksc)
             bufs["v"] = bufs["v"].at[li, rows, :, offs, :].set(vq)
@@ -451,6 +487,7 @@ class ServingEngine:
     def _build_decode(self):
         n_heads, eps, ps = self.n_heads, self.eps, self.page_size
         maxp, k_steps = self.max_pages, self.decode_block
+        n_kv = self.n_kv_heads
 
         def one_step(p, bufs, table, toks, lengths, active, key):
             s = toks.shape[0]
@@ -460,7 +497,8 @@ class ServingEngine:
             rows = jnp.where(active, table[jnp.arange(s), page_idx], 0)
             offs = lengths % ps
             for li, bp in enumerate(p["blocks"]):
-                q, kb, vb = _block_qkv(bp, x, n_heads, eps)
+                q, kb, vb = _block_qkv(bp, x, n_heads, eps,
+                                       n_kv_heads=n_kv)
                 q1, k1, v1 = q[:, :, 0], kb[:, :, 0], vb[:, :, 0]  # (S, H, D)
                 bufs = self._scatter_kv(bufs, li, rows, offs, k1, v1)
                 out = self._attend(q1, bufs, li, table, lengths + 1)
@@ -500,13 +538,14 @@ class ServingEngine:
         """Multi-query verify attention for layer ``li`` — kernel or jnp
         ref.  ``lengths`` counts the positions valid BEFORE the verify
         block (the paged_attention_mq contract)."""
-        if self.int8:
+        if self.kv_bits is not None:
             kw = dict(k_scales=bufs["ks"][li], v_scales=bufs["vs"][li])
         else:
             kw = {}
         fn = (pa.paged_attention_mq if self._use_spec_kernel
               else pa.paged_attention_mq_ref)
-        return fn(q, bufs["k"][li], bufs["v"][li], table, lengths, **kw)
+        return fn(q, bufs["k"][li], bufs["v"][li], table, lengths,
+                  window=self.window, **kw)
 
     def _build_verify(self):
         """The speculative verify program: ONE dispatch embeds each
@@ -525,6 +564,7 @@ class ServingEngine:
         argument that makes null-page garbage harmless."""
         n_heads, eps, ps = self.n_heads, self.eps, self.page_size
         maxp, t = self.max_pages, self.spec_k + 1
+        n_kv = self.n_kv_heads
 
         def verify(p, bufs, toks, draft, n_draft, lengths, table, key):
             self.stats["decode_traces"] += 1  # python side effect: per trace
@@ -545,8 +585,9 @@ class ServingEngine:
                 row_ok, jnp.take_along_axis(table, page_idx, axis=1), 0)
             offs = pos % ps
             for li, bp in enumerate(p["blocks"]):
-                q, kb, vb = _block_qkv(bp, x, n_heads, eps)  # q (S,H,T,D)
-                k1 = jnp.swapaxes(kb, 1, 2)                  # (S, T, H, D)
+                q, kb, vb = _block_qkv(bp, x, n_heads, eps,
+                                       n_kv_heads=n_kv)     # q (S,H,T,D)
+                k1 = jnp.swapaxes(kb, 1, 2)                  # (S, T, Hkv, D)
                 v1 = jnp.swapaxes(vb, 1, 2)
                 bufs = self._scatter_kv(bufs, li, rows, offs, k1, v1)
                 out = self._attend_spec(jnp.swapaxes(q, 1, 2), bufs, li,
@@ -563,6 +604,7 @@ class ServingEngine:
     def _build_prefill(self):
         n_heads, eps, ps = self.n_heads, self.eps, self.page_size
         maxp = self.max_pages
+        n_kv = self.n_kv_heads
 
         def prefill(p, bufs, toks, start, n_valid, table_row, sample_idx,
                     key):
@@ -583,7 +625,8 @@ class ServingEngine:
             rows = jnp.where(valid, table_row[page_idx], 0)
             offs = pos % ps
             for li, bp in enumerate(p["blocks"]):
-                q, kb, vb = _block_qkv(bp, x, n_heads, eps)
+                q, kb, vb = _block_qkv(bp, x, n_heads, eps,
+                                       n_kv_heads=n_kv)
                 # (1, H, C, D) -> (C, H, D): the page-scatter layout
                 q1 = jnp.swapaxes(q[0], 0, 1)
                 k1 = jnp.swapaxes(kb[0], 0, 1)
@@ -753,6 +796,11 @@ class ServingEngine:
                                    "cached pages with no live reference"),
             "queue_depth": g("serving_queue_depth", "waiting requests"),
             "slots_active": g("serving_slots_active", "occupied slots"),
+            "kv_bytes_per_token": g("serving_kv_bytes_per_token",
+                                    "pool HBM bytes one token position "
+                                    "costs across all layers"),
+            "pages_per_slot_p50": g("serving_pages_per_slot_p50",
+                                    "median live pages per occupied slot"),
             "hit_rate": g("serving_prefix_hit_rate",
                           "prefix_hit_tokens / prompt_tokens"),
             "budget_util": g("serving_token_budget_utilization",
@@ -1049,8 +1097,17 @@ class ServingEngine:
                 # become matchable for every later request
                 st.started = True
                 if self.pool.prefix is not None:
-                    nfull = st.base_len // self.page_size
-                    self.pool.prefix.insert(work, st.pages[:nfull])
+                    if (self.window is not None
+                            and st.base_len > self.window):
+                        # the prompt extends past the window boundary:
+                        # its leading pages are already invisible to every
+                        # future query, and windowed recycling is about to
+                        # free them — indexing would pin dead pages in the
+                        # cache, so refuse cleanly and count it
+                        self.pool.prefix.window_refusals += 1
+                    else:
+                        nfull = st.base_len // self.page_size
+                        self.pool.prefix.insert(work, st.pages[:nfull])
                 tok = int(tok)
                 st.tokens.append(tok)
                 self._emit_token(req, tok)
@@ -1089,14 +1146,18 @@ class ServingEngine:
         decode this step (False: it was preempted itself, or stalled
         because no victim remains — retried next step)."""
         st = self._slots[idx]
+        # grow from the HIGH-WATER page count, not len(pages): windowed
+        # recycling shrinks the live page list but table positions keep
+        # advancing — logical page i always lives at table column i
         need = self.pool.pages_for(int(self._len[idx]) + consumed) \
-            - len(st.pages)
+            - st.hw_pages
         while need > 0:
             got = self.pool.alloc(need)
             if got is not None:
                 row = self._table[idx]
-                row[len(st.pages):len(st.pages) + len(got)] = got
+                row[st.hw_pages:st.hw_pages + len(got)] = got
                 st.pages.extend(got)
+                st.hw_pages += len(got)
                 return True
             if self.pool.num_free + self.pool.num_reclaimable >= need:
                 # the pool COULD satisfy the lease, so the failure is a
@@ -1111,6 +1172,30 @@ class ServingEngine:
             if victim == idx:
                 return False          # the grower was the youngest itself
         return True
+
+    def _recycle_window_pages(self, idx: int) -> None:
+        """Sliding-window page recycling: once every position of a slot's
+        leading logical page has fallen out of the attention window — page
+        j is dead iff ``(j+1)*page_size <= len+1-window``, i.e. the next
+        query at position ``len`` cannot see any of it — the page goes
+        back to the pool and its table entry becomes the null page (safe:
+        the window mask already excludes those positions from every
+        kernel and reference).  A slot's live footprint becomes a RING of
+        ~ceil(window/page_size)+1 pages, so long generations stop
+        growing.  Shared (prefix-cached) pages just drop this slot's
+        reference; only STARTED slots recycle (prefill still writes the
+        whole prompt)."""
+        st = self._slots[idx]
+        if st is None or self.window is None or not st.started:
+            return
+        dead = (int(self._len[idx]) + 1 - self.window) // self.page_size
+        done = st.hw_pages - len(st.pages)    # leading pages already freed
+        if dead <= done:
+            return
+        victims = st.pages[:dead - done]
+        del st.pages[:dead - done]
+        self._table[idx, done:dead] = 0
+        self.pool.free(victims)
 
     def step(self) -> List[FinishedRequest]:
         """One engine iteration: expire deadlines, admit into freed
@@ -1190,6 +1275,10 @@ class ServingEngine:
         m["pages_reclaimable"].set(self.pool.num_reclaimable)
         m["queue_depth"].set(self.scheduler.n_waiting)
         m["slots_active"].set(self.scheduler.n_active)
+        m["kv_bytes_per_token"].set(self.pool.bytes_per_token())
+        held = sorted(len(s.pages) for s in self._slots if s is not None)
+        m["pages_per_slot_p50"].set(
+            held[len(held) // 2] if held else 0)
         m["hit_rate"].set(self.prefix_hit_rate())
         m["budget_util"].set(self._tokens_this_step
                              / max(self.scheduler.token_budget, 1))
@@ -1286,6 +1375,7 @@ class ServingEngine:
                     # and its carry token is the last sampled one
                     self._tok[idx] = int(toks_all[consumed - 1, idx])
                     self._len[idx] += consumed
+                    self._recycle_window_pages(idx)
 
     def _spec_decode_step(self, finished: List[FinishedRequest]) -> None:
         """One speculative iteration over the started slots: draft from
@@ -1380,6 +1470,7 @@ class ServingEngine:
                 # bonus/correction token, whose K/V the next step writes
                 self._tok[idx] = emitted[n_new - 1]
                 self._len[idx] += n_new
+                self._recycle_window_pages(idx)
 
     def check_invariants(self) -> None:
         """Page-leak / refcount / scheduler-consistency audit.  The pool's
@@ -1411,6 +1502,33 @@ class ServingEngine:
                 raise AssertionError(
                     f"slot {i} occupancy disagrees with the scheduler's "
                     "free-slot list")
+        # windowed page arithmetic (KV-capacity PR): recycling must keep
+        # every started slot's live footprint a bounded ring — high-water
+        # never below the live count, and the live count within one
+        # step's growth of ceil(window/page_size)+1 pages.  Without a
+        # window the high-water mark and the live list must agree exactly.
+        cmax = max(self.decode_block, self.spec_k + 1)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if s.hw_pages < len(s.pages):
+                raise AssertionError(
+                    f"slot {i} high-water {s.hw_pages} below live page "
+                    f"count {len(s.pages)}")
+            if self.window is None:
+                if s.hw_pages != len(s.pages):
+                    raise AssertionError(
+                        f"slot {i} recycled pages without a window "
+                        f"(hw {s.hw_pages}, live {len(s.pages)})")
+            elif s.started:
+                length = int(self._len[i])
+                cap = self.pool.pages_for(
+                    min(length + cmax, self.window + cmax)) + 1
+                if len(s.pages) > cap:
+                    raise AssertionError(
+                        f"slot {i} holds {len(s.pages)} pages at len "
+                        f"{length} under window {self.window}; ring cap "
+                        f"is {cap}")
         # speculative draft buffers (r13): a slot's draft must stay
         # within the engine's spec window and the request's remaining
         # budget, and only DECODING slots may hold one — whatever step

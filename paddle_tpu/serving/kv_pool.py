@@ -51,18 +51,38 @@ class KVPool:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_pages: int, page_size: int, dtype=jnp.float32,
-                 int8: bool = False, prefix_cache: bool = False):
+                 int8: bool = False, prefix_cache: bool = False,
+                 num_kv_heads: Optional[int] = None,
+                 kv_bits: Optional[int] = None,
+                 window: Optional[int] = None):
         if num_pages < 2:
             raise ValueError("KVPool needs >= 2 pages (page 0 is the "
                              "reserved null page)")
+        if kv_bits is None and int8:
+            kv_bits = 8
+        if kv_bits not in (None, 4, 8):
+            raise ValueError(f"kv_bits must be None, 4 or 8, got {kv_bits}")
+        kv_heads = num_kv_heads or num_heads
+        if num_heads % kv_heads != 0:
+            raise ValueError(f"num_heads={num_heads} not divisible by "
+                             f"num_kv_heads={kv_heads}")
+        if kv_bits == 4 and head_dim % 2 != 0:
+            raise ValueError("kv_bits=4 needs an even head_dim "
+                             "(two nibbles per byte)")
         self.num_layers = num_layers
         self.num_heads = num_heads
+        self.num_kv_heads = kv_heads
         self.head_dim = head_dim
         self.num_pages = num_pages
         self.page_size = page_size
-        self.int8 = int8
-        shape = (num_layers, num_pages, num_heads, page_size, head_dim)
-        if int8:
+        self.kv_bits = kv_bits
+        self.int8 = kv_bits is not None
+        self.window = window
+        # int4 pages pack two nibbles per byte: stored last dim is D//2,
+        # with the SAME per-(page-position, head) fp32 scale layout as int8
+        store_d = head_dim // 2 if kv_bits == 4 else head_dim
+        shape = (num_layers, num_pages, kv_heads, page_size, store_d)
+        if kv_bits is not None:
             self.buffers: Dict[str, jnp.ndarray] = {
                 "k": jnp.zeros(shape, jnp.int8),
                 "v": jnp.zeros(shape, jnp.int8),
@@ -211,3 +231,26 @@ class KVPool:
 
     def hbm_bytes(self) -> int:
         return sum(b.size * b.dtype.itemsize for b in self.buffers.values())
+
+    def bytes_per_token(self) -> int:
+        """HBM bytes one token position costs across all layers and both
+        sides — the capacity denominator the KV-capacity bench reports
+        (GQA divides it by the group factor, int8 by ~4, int4 by ~8)."""
+        per_side = sum(
+            b.dtype.itemsize * self.num_kv_heads
+            * (b.shape[-1] if name in ("k", "v") else 1)
+            for name, b in self.buffers.items())
+        return self.num_layers * per_side
+
+    def layout(self) -> Dict[str, object]:
+        """The pool's KV storage layout — everything that must MATCH for
+        another pool's pages to be byte-compatible with this one (what
+        snapshot v5 records and restore() refuses to mix)."""
+        return {
+            "kv_heads": self.num_kv_heads,
+            "page_dtype": str(self.buffers["k"].dtype),
+            "kv_bits": self.kv_bits,
+            "window": self.window,
+            "page_size": self.page_size,
+            "head_dim": self.head_dim,
+        }

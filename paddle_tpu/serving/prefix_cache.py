@@ -58,6 +58,10 @@ class PrefixIndex:
         # engine mirrors into its metrics registry — rising evictions at
         # a flat hit rate means the working set outgrew the pool
         self.evictions = 0
+        # prompts NOT indexed because sliding-window attention would
+        # recycle their pages past the window boundary — the clean-refusal
+        # counter the engine increments instead of inserting
+        self.window_refusals = 0
 
     def __len__(self) -> int:
         return len(self._by_page)
@@ -166,7 +170,8 @@ class PrefixIndex:
 
         walk(self.root)
         return {"page_size": self.page_size, "tick": self._tick,
-                "nodes": nodes, "evictions": self.evictions}
+                "nodes": nodes, "evictions": self.evictions,
+                "window_refusals": self.window_refusals}
 
     @classmethod
     def from_state(cls, state: dict) -> "PrefixIndex":
@@ -183,6 +188,7 @@ class PrefixIndex:
             by_page[node.page] = node
         idx._tick = int(state["tick"])
         idx.evictions = int(state.get("evictions", 0))
+        idx.window_refusals = int(state.get("window_refusals", 0))
         return idx
 
     # -- eviction ---------------------------------------------------------

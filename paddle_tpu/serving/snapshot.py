@@ -58,8 +58,15 @@ from .scheduler import Request
 #: deliberately NOT captured — the drafter is deterministic over request
 #: history, so a restored engine re-drafts and stays token-exact
 #: (tests/test_speculative.py).  Older snapshots load with zero counters.
-SNAPSHOT_VERSION = 4
-_READABLE_VERSIONS = (2, 3, 4)
+#: v5 (KV-capacity PR): the snapshot records the pool's KV page LAYOUT
+#: (kv heads, page dtype, kv_bits, window, page geometry) and restore
+#: refuses an engine whose rebuilt pool lays pages out differently — the
+#: captured page bytes would be reinterpreted silently otherwise.  Slots
+#: carry ``hw_pages`` (windowed-recycling high-water mark); older
+#: snapshots default it to the live page count (exact: they predate
+#: recycling, so the two never diverged).
+SNAPSHOT_VERSION = 5
+_READABLE_VERSIONS = (2, 3, 4, 5)
 
 
 def _request_state(req: Request) -> dict:
@@ -116,11 +123,13 @@ def snapshot_engine(eng) -> dict:
                               prefilled=int(st.prefilled),
                               started=bool(st.started), seq=int(st.seq),
                               base_len=int(st.base_len),
-                              born_step=int(st.born_step)))
+                              born_step=int(st.born_step),
+                              hw_pages=int(st.hw_pages)))
     pool = eng.pool
     return {
         "version": SNAPSHOT_VERSION,
         "config": dict(eng._config),
+        "kv_layout": pool.layout(),
         "engine": dict(
             step_idx=int(eng._step_idx), admit_seq=int(eng._admit_seq),
             key=np.asarray(eng._key).copy(), tok=eng._tok.copy(),
@@ -167,6 +176,23 @@ def restore_engine(model, snap: dict, **overrides):
     cfg = dict(snap["config"])
     cfg.update(overrides)
     eng = ServingEngine(model, **cfg)
+
+    # v5: the captured page bytes are only meaningful under the layout
+    # that wrote them — a rebuilt pool with different KV heads, page
+    # dtype, quantization width or window would reinterpret them
+    # silently, so refuse loudly instead (v<5 snapshots predate every
+    # non-default layout and skip the check)
+    want = snap.get("kv_layout")
+    if want is not None:
+        have = eng.pool.layout()
+        if have != want:
+            diff = {k: (want[k], have[k]) for k in want
+                    if have.get(k) != want[k]}
+            raise ValueError(
+                "snapshot KV layout does not match the rebuilt engine's "
+                f"pool — snapshot vs engine: {diff}; restore onto a model/"
+                "config with the same kv layout (kv heads, page dtype, "
+                "kv_bits, window, page geometry)")
 
     # rids must keep minting above anything the snapshot ever issued
     _sched._next_rid.n = max(_sched._next_rid.n, int(snap["rid_next"]))
@@ -218,6 +244,8 @@ def restore_engine(model, snap: dict, **overrides):
                    base_len=sstate["base_len"])
         st.started = sstate["started"]
         st.born_step = sstate["born_step"]
+        # pre-v5 snapshots predate windowed recycling: hw == live pages
+        st.hw_pages = int(sstate.get("hw_pages", len(st.pages)))
         _rebase(req)
         eng._slots[idx] = st
         eng.scheduler.note_restored_slot(req)

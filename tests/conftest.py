@@ -38,6 +38,11 @@ def pytest_configure(config):
         "chaos: seeded fault-injection runs against the serving engine "
         "(tests/test_serving_faults.py) — deterministic, CPU-runnable, "
         "included in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "kvcap: KV-capacity matrix (GQA / sliding-window / int4 pages) "
+        "parity and accounting tests (tests/test_kv_capacity.py) — "
+        "CPU-runnable, included in tier-1")
 
 
 @pytest.fixture(autouse=True)

@@ -305,6 +305,57 @@ def test_spec_serving_bench_smoke():
     assert res["config"]["spec_k"] == 2
 
 
+def test_kv_capacity_bench_smoke():
+    """Fast CPU smoke of the KV-capacity bench (ISSUE r14): all four legs
+    (mha / gqa / gqa+window / gqa+int4) complete the identical load at a
+    FIXED pool byte budget, bytes/token strictly shrinks mha > gqa >
+    gqa_int4, the capacity winner holds >= 2x the concurrent slots with
+    no more preemptions or recompute than the baseline, and the per-leg
+    registry dicts carry the capacity gauges every serving bench embeds."""
+    res = bench._kv_capacity_bench(hidden=64, layers=2, heads=4, vocab=256,
+                                   n_requests=8, max_slots=8, page_size=8,
+                                   prompt_len=12, new_tokens=12,
+                                   dtype="float32", kv_group=4, window=8,
+                                   decode_block=2)
+    legs = res
+    for leg in ("mha", "gqa", "gqa_window", "gqa_int4"):
+        assert legs[leg]["goodput_tokens_per_sec"] > 0
+        assert legs[leg]["peak_concurrent_slots"] >= 1
+        m = legs[leg]["metrics"]
+        assert m["serving_kv_bytes_per_token"] == legs[leg]["kv_bytes_per_token"]
+        assert "serving_pages_per_slot_p50" in m
+    bpt = {leg: legs[leg]["kv_bytes_per_token"]
+           for leg in ("mha", "gqa", "gqa_int4")}
+    assert bpt["mha"] > bpt["gqa"] > bpt["gqa_int4"]
+    # every leg got MORE pages out of the same byte budget than mha
+    assert legs["gqa_int4"]["pool_pages"] > legs["gqa"]["pool_pages"] \
+        > legs["mha"]["pool_pages"]
+    assert res["capacity_multiplier_gqa_int4_vs_mha"] >= 8.0
+    assert res["concurrency_ratio_gqa_int4_vs_mha"] >= 2.0
+    assert legs["gqa_int4"]["preemptions"] <= legs["mha"]["preemptions"]
+    assert legs["gqa_int4"]["recompute_tokens"] <= legs["mha"]["recompute_tokens"]
+    assert res["config"]["pool_budget_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_kv_capacity_bench_tpu_scale():
+    """The flagship-sized KV-capacity point bench.py records on TPU
+    (marked slow).  The r14 acceptance bar lives here: at an equal pool
+    byte budget, GQA(4x) + int4 pages serve >= 2x the concurrent slots of
+    the MHA/full-precision baseline, with preemptions and recompute
+    tokens no higher."""
+    res = bench._kv_capacity_bench(hidden=1536, layers=24, heads=12,
+                                   vocab=50304, n_requests=32, max_slots=16,
+                                   page_size=64, prompt_len=96,
+                                   new_tokens=96, dtype="bfloat16",
+                                   kv_group=4, window=64, decode_block=8)
+    legs = res
+    assert res["concurrency_ratio_gqa_int4_vs_mha"] >= 2.0, res
+    assert legs["gqa_int4"]["preemptions"] <= legs["mha"]["preemptions"], res
+    assert legs["gqa_int4"]["recompute_tokens"] \
+        <= legs["mha"]["recompute_tokens"], res
+
+
 @pytest.mark.slow
 def test_spec_serving_bench_tpu_scale():
     """The flagship-sized speculative point bench.py records on TPU

@@ -624,6 +624,35 @@ def test_serving_imports_only_jax_numpy_stdlib():
         f"disallowed/mis-scoped absolute imports: {offenders}"
 
 
+def test_int4_kv_helpers_import_only_jax_numpy_stdlib():
+    """The int4 pack/unpack helpers the KV pool and paged kernels share
+    (ops/quant_ops.py, r14) sit on the serving-critical import path — the
+    same no-new-deps discipline applies: jax/numpy/stdlib only, with
+    paddle_tpu-relative imports free."""
+    from paddle_tpu.ops import quant_ops
+
+    fname = os.path.basename(quant_ops.__file__)
+    tree = ast.parse(open(quant_ops.__file__).read())
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if not (_stdlib(root) or root in _ALLOWED_ROOTS):
+                    offenders.append((fname, alias.name))
+        elif isinstance(node, ast.ImportFrom):
+            if node.level > 0:
+                continue
+            root = (node.module or "").split(".")[0]
+            if not (_stdlib(root) or root in _ALLOWED_ROOTS
+                    or root == "paddle_tpu"):
+                offenders.append((fname, node.module))
+    assert not offenders, f"disallowed absolute imports: {offenders}"
+    for helper in ("pack_int4", "unpack_int4", "quantize_int4_per_token",
+                   "quantize_per_token"):
+        assert callable(getattr(quant_ops, helper))
+
+
 def test_serving_runtime_modules_loaded_clean():
     """Belt to the AST braces: every serving module is already imported
     (this file imported the package) — none of the forbidden client

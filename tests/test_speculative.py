@@ -333,7 +333,7 @@ def test_engine_spec_preempt_recompute_exact():
 
 def test_engine_spec_snapshot_restore_exact():
     """Snapshot/restore with speculation ON: draft state is host-only
-    and reconstructible, so a v4 snapshot taken mid-speculation restores
+    and reconstructible, so a snapshot taken mid-speculation restores
     to token-for-token identical output — and the per-request spec
     counters survive the round trip."""
     from paddle_tpu.serving import restore_engine, snapshot_engine
@@ -351,7 +351,7 @@ def test_engine_spec_snapshot_restore_exact():
         for f in eng.step():
             done_pre[f.rid] = f
     snap = snapshot_engine(eng)
-    assert snap["version"] == 4
+    assert snap["version"] == 5
     assert snap["config"]["spec_k"] == 2
     # draft buffers are never captured (host-only, reconstructible)
     for s in snap["slots"]:

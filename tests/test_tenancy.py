@@ -373,7 +373,7 @@ def test_engine_wfq_snapshot_restores_virtual_counters():
     vt_before = dict(eng.scheduler.policy.vt)
     assert any(v > 0 for v in vt_before.values())
     snap = eng.snapshot()
-    assert snap["version"] == SNAPSHOT_VERSION == 4
+    assert snap["version"] == SNAPSHOT_VERSION == 5
     assert snap["scheduler"]["policy"]["name"] == "wfq"
 
     eng2 = ServingEngine.restore(model, snap)
