@@ -204,6 +204,13 @@ def main():
             hidden=1536, layers=24, heads=12, vocab=50304, n_requests=32,
             max_slots=16, page_size=64, prompt_len=96, new_tokens=96,
             dtype="bfloat16", kv_group=4, window=64, decode_block=8)
+        # disaggregated 2-replica cluster vs the monolith, plus the
+        # double-buffered dispatch overlap (ISSUE r15 acceptance: >= 1.7x
+        # aggregate goodput with p99 TTFT no worse)
+        serving_disagg = _disagg_serving_bench(
+            hidden=1536, layers=24, heads=12, vocab=50304, n_requests=48,
+            max_slots=8, page_size=64, prompt_len=96, shared_len=64,
+            new_tokens=96, dtype="bfloat16", decode_block=8)
         resnet = _resnet50_bench()
         bert = _bert_bench()
         head = flagship
@@ -253,6 +260,10 @@ def main():
             hidden=64, layers=2, heads=4, vocab=256, n_requests=8,
             max_slots=8, page_size=8, prompt_len=12, new_tokens=12,
             dtype="float32", kv_group=4, window=8, decode_block=2)
+        serving_disagg = _disagg_serving_bench(
+            hidden=64, layers=2, heads=2, vocab=256, n_requests=6,
+            max_slots=2, page_size=8, prompt_len=16, shared_len=8,
+            new_tokens=12, dtype="float32", decode_block=2)
         small = None
 
     out = {
@@ -278,6 +289,7 @@ def main():
     out["extra"]["serving_slo"] = serving_slo
     out["extra"]["serving_spec"] = serving_spec
     out["extra"]["serving_kv_capacity"] = serving_kv_capacity
+    out["extra"]["serving_disagg"] = serving_disagg
     # r11 acceptance guard: feeding the metrics registry + tracer every
     # step must not move engine goodput (CPU-sized on purpose — python
     # host-loop overhead is what it measures)
@@ -1155,6 +1167,183 @@ def _kv_capacity_bench(hidden=1536, layers=24, heads=12, vocab=50304,
                    "pool_budget_bytes": int(budget),
                    "decode_block": decode_block,
                    "useful_tokens": useful},
+    }
+
+
+def _disagg_serving_bench(hidden=1536, layers=24, heads=12, vocab=50304,
+                          n_requests=48, max_slots=8, page_size=64,
+                          prompt_len=96, shared_len=0, new_tokens=96,
+                          dtype="bfloat16", decode_block=8,
+                          overload_factor=3.0, seed=0):
+    """Disaggregated multi-replica serving vs one monolithic engine (r15).
+
+    A mixed-length Poisson load (prompt lengths uniform in
+    [prompt_len/2, prompt_len], per-request new-token budgets uniform in
+    [new_tokens/2, new_tokens], arrivals at ``overload_factor`` x the
+    single engine's measured burst capacity, first ``shared_len`` tokens
+    shared so the router's prefix probe has something to hit) runs
+    through three serving topologies with the same weights and greedy
+    sampling:
+
+      * **single**: one ``ServingEngine(role="both")`` — the r08-r14
+        monolith, the baseline every prior bench measured;
+      * **single_db**: the same engine with ``double_buffer=True`` —
+        step N+1 is scheduled on host while step N's decode dispatch
+        runs on device, so the reported ``decode_sync_s`` (host time
+        blocked in ``jax.block_until_ready``) is the direct measure of
+        the recovered overlap;
+      * **cluster2**: ``make_cluster(n=2, disaggregate=True)`` — a
+        prefill replica and a decode replica behind the cache- and
+        load-aware Router, every request crossing the boundary through
+        the v5 page-payload handoff.
+
+    Reported per leg: aggregate goodput tokens/s of COMPLETED requests,
+    p99 TTFT (arrival -> first streamed token, through the on_token
+    hook), makespan; for the cluster additionally the router's routing
+    counters (per-replica spread, prefix hit-rate over admissions) and
+    the handoff ledger (records, bytes, degraded).  BENCH acceptance
+    (tests/test_bench_extras.py): CPU smoke asserts shape + routing
+    counters; the slow TPU leg asserts cluster goodput >= 1.7x single
+    with p99 TTFT no worse, and double buffering shrinking the sync
+    stall.
+    """
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine, make_cluster
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_seq_len=prompt_len + new_tokens,
+                    dropout=0.0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    if dtype == "bfloat16":
+        for p in model.parameters():
+            p._array = p._array.astype(jnp.bfloat16)
+
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab, (shared_len,)).astype("int32")
+    plens = rng.randint(max(prompt_len // 2, shared_len + 2),
+                        prompt_len + 1, n_requests)
+    prompts = [np.concatenate([shared, rng.randint(
+        0, vocab, (int(n) - shared_len,)).astype("int32")]) for n in plens]
+    news = rng.randint(max(new_tokens // 2, 1), new_tokens + 1, n_requests)
+
+    def build_single(db=False):
+        eng = ServingEngine(model, max_slots=max_slots, page_size=page_size,
+                            greedy=True, decode_block=decode_block,
+                            double_buffer=db)
+        eng.add_request(prompts[0], 2)      # compile prefill + decode
+        eng.run()
+        _reset_mirrored_stats(eng)
+        eng.stats["decode_sync_s"] = 0.0
+        return eng
+
+    def build_cluster():
+        router = make_cluster(model, 2, disaggregate=True,
+                              max_slots=max_slots, page_size=page_size,
+                              greedy=True, decode_block=decode_block)
+        router.run([(prompts[0], 2)])       # compile both replicas
+        for eng in router.replicas:
+            _reset_mirrored_stats(eng)
+            for k in ("handoffs_out", "handoffs_in", "handoff_bytes",
+                      "handoff_faults"):
+                eng.stats[k] = 0
+        for k, v in router.stats.items():
+            router.stats[k] = [0] * len(v) if isinstance(v, list) else 0
+        return router
+
+    def drive(target, arrivals):
+        """Poisson-feed ``target`` (engine or Router — same five-method
+        surface) and measure goodput + TTFT through the streaming hook."""
+        order = np.argsort(arrivals, kind="stable")
+        pending = [(float(arrivals[j]), int(j)) for j in order]
+        rid2idx, fins, first_tok = {}, {}, {}
+        t0 = time.perf_counter()
+        target.on_token = lambda rid, tok: first_tok.setdefault(
+            rid, time.perf_counter() - t0)
+        makespan = 1e-9
+        while pending or target.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, j = pending.pop(0)
+                rid = target.add_request(prompts[j], int(news[j]))
+                rid2idx[rid] = j
+            if not target.has_work:
+                if pending:
+                    time.sleep(min(pending[0][0] - now, 0.01))
+                continue
+            for fin in target.step():
+                done = time.perf_counter() - t0
+                fins[fin.rid] = (fin, done)
+                makespan = done
+        target.on_token = None
+        good = sum(int(f.tokens.size) for f, _ in fins.values() if f.ok)
+        ttfts = [first_tok[rid] - arrivals[rid2idx[rid]]
+                 for rid in fins if rid in first_tok]
+        return {
+            "goodput_tokens_per_sec": round(good / makespan, 1),
+            "p99_ttft_s": (round(float(np.percentile(ttfts, 99)), 4)
+                           if ttfts else None),
+            "makespan_s": round(makespan, 3),
+            "completed": sum(1 for f, _ in fins.values() if f.ok),
+        }
+
+    # -- phase 1: burst calibration on the monolith (also its warmup) ----
+    eng_single = build_single()
+    burst = drive(eng_single, np.zeros(n_requests))
+    rate = overload_factor * n_requests / burst["makespan_s"]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+
+    # -- phase 2: the SAME Poisson trace through all three topologies ----
+    single = drive(eng_single, arrivals)          # drained: reusable
+    single["decode_sync_s"] = round(eng_single.stats["decode_sync_s"], 4)
+
+    eng_db = build_single(db=True)
+    single_db = drive(eng_db, arrivals)
+    single_db["decode_sync_s"] = round(eng_db.stats["decode_sync_s"], 4)
+
+    router = build_cluster()
+    cluster = drive(router, arrivals)
+    routed_total = max(sum(router.stats["routed"]), 1)
+    cluster["router"] = {
+        "routed": list(router.stats["routed"]),
+        "prefix_hit_rate": round(
+            router.stats["prefix_routed"] / routed_total, 4),
+        "prefix_match_tokens": router.stats["prefix_match_tokens"],
+        "handoffs": router.stats["handoffs"],
+        "handoff_bytes": router.stats["handoff_bytes"],
+        "degraded_handoffs": router.stats["degraded_handoffs"],
+        "rejected": router.stats["rejected"],
+    }
+    cluster["per_replica"] = [
+        {"role": eng.role,
+         "prefill_calls": eng.stats["prefill_calls"],
+         "decode_calls": eng.stats["decode_calls"],
+         "tokens_generated": eng.stats["tokens_generated"],
+         "handoffs_out": eng.stats["handoffs_out"],
+         "handoffs_in": eng.stats["handoffs_in"]}
+        for eng in router.replicas]
+
+    return {
+        "single": single,
+        "single_db": single_db,
+        "cluster2": cluster,
+        "speedup_cluster_vs_single": round(
+            cluster["goodput_tokens_per_sec"]
+            / max(single["goodput_tokens_per_sec"], 1e-9), 3),
+        "decode_sync_ratio_db_vs_off": round(
+            single_db["decode_sync_s"]
+            / max(single["decode_sync_s"], 1e-9), 3),
+        "config": {"hidden": hidden, "layers": layers, "heads": heads,
+                   "vocab": vocab, "n_requests": n_requests,
+                   "max_slots": max_slots, "page_size": page_size,
+                   "prompt_len": prompt_len, "shared_len": shared_len,
+                   "new_tokens": new_tokens, "dtype": dtype,
+                   "decode_block": decode_block,
+                   "overload_factor": overload_factor,
+                   "arrival_rate_req_per_s": round(float(rate), 3)},
     }
 
 

@@ -40,6 +40,16 @@ CPU-runnable out of the box (tiny config); flags scale it up::
         # /healthz) with weighted-fair multi-tenant scheduling:
         #   curl -N localhost:8000/v1/completions \
         #        -d '{"prompt": [1,2,3], "max_tokens": 8, "tenant": "a"}'
+    python examples/serve_gpt.py --replicas 2 --disaggregate
+        # r15: a prefill replica and a decode replica behind the cache-
+        # and load-aware Router; prefilled KV pages cross the boundary
+        # through the v5 handoff and the summary prints the routing +
+        # handoff ledger.  Composes with --http / --tenants (tenant
+        # fairness is enforced CLUSTER-wide via the shared WFQ ledger)
+    python examples/serve_gpt.py --double-buffer
+        # r15: dispatch decode step N on device, schedule step N+1 on
+        # host, sync one step late — the summary prints the host time
+        # still blocked on the device
 """
 
 import argparse
@@ -116,7 +126,27 @@ def main():
                          "'a:3,b:1') enabling weighted-fair multi-tenant "
                          "scheduling; requests pick their tenant via the "
                          "HTTP body's \"tenant\" field")
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="serve through a Router over N engine replicas "
+                         "(cache-affinity + load routing, cluster-wide "
+                         "WFQ fairness) instead of one engine (r15)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="with --replicas >= 2: split the fleet into "
+                         "prefill and decode replicas; prefilled KV "
+                         "pages cross the boundary via the page-payload "
+                         "handoff (r15)")
+    ap.add_argument("--double-buffer", action="store_true",
+                    help="overlap host scheduling of step N+1 with the "
+                         "device running step N (sync one step late; "
+                         "excludes --speculate) (r15)")
     args = ap.parse_args()
+    cluster = args.replicas > 1
+    if cluster and (args.inject_faults is not None
+                    or args.metrics_dir is not None or args.speculate):
+        ap.error("--replicas > 1 demos routing/handoff; run "
+                 "--inject-faults / --metrics-dir / --speculate on the "
+                 "single-engine demo (chaos + exporters per replica are "
+                 "exercised in tests/test_disagg.py)")
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
@@ -138,18 +168,37 @@ def main():
         for part in args.tenants.split(","):
             name, _, weight = part.partition(":")
             tenants[name.strip()] = float(weight) if weight else 1.0
-    eng = ServingEngine(model, max_slots=args.slots,
-                        page_size=args.page_size,
-                        decode_block=args.decode_block,
-                        chunk_tokens=args.chunk_tokens,
-                        prefix_cache=not args.no_prefix_cache,
-                        greedy=args.top_p >= 1.0, top_p=args.top_p,
-                        eos_token_id=args.eos, int8=args.int8,
-                        max_queue=args.max_queue, faults=faults,
-                        tenants=tenants, spec_k=args.speculate,
-                        kv_bits=args.kv_bits,
-                        metrics=args.metrics_dir is not None,
-                        trace=args.metrics_dir is not None)
+    if cluster:
+        from paddle_tpu.serving import make_cluster
+
+        eng = make_cluster(model, args.replicas,
+                           disaggregate=args.disaggregate,
+                           tenants=tenants,
+                           router_max_queue=args.max_queue,
+                           max_slots=args.slots,
+                           page_size=args.page_size,
+                           decode_block=args.decode_block,
+                           chunk_tokens=args.chunk_tokens,
+                           prefix_cache=not args.no_prefix_cache,
+                           greedy=args.top_p >= 1.0, top_p=args.top_p,
+                           eos_token_id=args.eos, int8=args.int8,
+                           kv_bits=args.kv_bits,
+                           double_buffer=args.double_buffer)
+    else:
+        eng = ServingEngine(model, max_slots=args.slots,
+                            page_size=args.page_size,
+                            decode_block=args.decode_block,
+                            chunk_tokens=args.chunk_tokens,
+                            prefix_cache=not args.no_prefix_cache,
+                            greedy=args.top_p >= 1.0, top_p=args.top_p,
+                            eos_token_id=args.eos, int8=args.int8,
+                            max_queue=args.max_queue, faults=faults,
+                            tenants=tenants, spec_k=args.speculate,
+                            kv_bits=args.kv_bits,
+                            double_buffer=args.double_buffer,
+                            metrics=args.metrics_dir is not None,
+                            trace=args.metrics_dir is not None)
+    replicas = eng.replicas if cluster else [eng]
     if args.http is not None:
         from paddle_tpu.serving.frontend import serve
 
@@ -158,7 +207,10 @@ def main():
         eng.add_request(np.arange(4, dtype=np.int32), 2)
         eng.run()
         print(f"engine warm: slots={args.slots} policy="
-              f"{eng.scheduler.policy.name} tenants={tenants or '-'}")
+              f"{replicas[0].scheduler.policy.name} "
+              f"tenants={tenants or '-'}"
+              + (f" replicas={[e.role for e in replicas]}"
+                 if cluster else ""))
         try:
             serve(eng, port=args.http)
         finally:
@@ -182,12 +234,19 @@ def main():
         os.makedirs(args.metrics_dir, exist_ok=True)
         exporter = MetricsFileExporter(eng.metrics, args.metrics_dir)
         attach_profiler(eng.tracer)   # host RecordEvent spans join the trace
-    print(f"engine: slots={args.slots} page_size={args.page_size} "
-          f"pool={eng.pool.num_pages} pages "
-          f"({eng.pool.hbm_bytes() / 1e6:.1f} MB) int8={args.int8}")
-    print(f"kv layout: {eng.pool.num_kv_heads}/{args.heads} kv heads, "
-          f"kv_bits={eng.kv_bits or '-'} window={eng.window or '-'} -> "
-          f"{eng.pool.bytes_per_token()} KV bytes/token")
+    e0 = replicas[0]
+    if cluster:
+        print(f"cluster: {args.replicas} replicas "
+              f"{[e.role for e in replicas]} — cache-affinity + load "
+              f"routing, {'page-payload handoff, ' if args.disaggregate else ''}"
+              f"{'cluster-wide WFQ' if tenants else 'FCFS'}")
+    print(f"engine: slots={args.slots}/replica page_size={args.page_size} "
+          f"pool={e0.pool.num_pages} pages "
+          f"({e0.pool.hbm_bytes() / 1e6:.1f} MB) int8={args.int8} "
+          f"double_buffer={args.double_buffer}")
+    print(f"kv layout: {e0.pool.num_kv_heads}/{args.heads} kv heads, "
+          f"kv_bits={e0.kv_bits or '-'} window={e0.window or '-'} -> "
+          f"{e0.pool.bytes_per_token()} KV bytes/token")
 
     rng = np.random.RandomState(0)
     system = rng.randint(0, args.vocab, (args.shared_prefix,))
@@ -208,20 +267,23 @@ def main():
     n_done, step = 0, 0
     while eng.has_work:
         step += 1
-        occupancy = eng.scheduler.n_active
+        occupancy = sum(e.scheduler.n_active for e in replicas)
         for fin in eng.step():
             n_done += 1
             plen, new = rids[fin.rid]
             print(f"  step {step:4d} | done rid={fin.rid} "
                   f"({fin.finish_reason}, {len(fin.tokens)}/{new} tokens, "
                   f"resident {fin.n_steps} steps) | "
-                  f"pool util {eng.pool.utilization():.0%} | "
-                  f"slots busy {occupancy}/{args.slots}")
+                  f"pool util "
+                  f"{max(e.pool.utilization() for e in replicas):.0%} | "
+                  f"slots busy {occupancy}/{args.slots * len(replicas)}")
         if exporter is not None:
             exporter.flush(step)
     dt = time.perf_counter() - t0
 
-    s = eng.stats
+    s = {k: sum(e.stats[k] for e in replicas)
+         for k, v in replicas[0].stats.items()
+         if isinstance(v, (int, float))}
     print(f"\n{n_done} requests, {s['tokens_generated']} tokens in {dt:.2f}s "
           f"({s['tokens_generated'] / dt:.1f} tok/s)")
     print(f"programs: {s['prefill_traces']} prefill trace(s) "
@@ -230,8 +292,22 @@ def main():
           f"two jitted programs instead of retracing per request")
     print(f"prefix cache: {s['prefix_hit_tokens']}/{s['prompt_tokens']} "
           f"prompt tokens served from cached pages "
-          f"({eng.prefix_hit_rate():.0%} hit rate), "
-          f"{eng.pool.num_cached} pages cached for future requests")
+          f"({s['prefix_hit_tokens'] / max(s['prompt_tokens'], 1):.0%} "
+          f"hit rate), {sum(e.pool.num_cached for e in replicas)} pages "
+          f"cached for future requests")
+    if cluster:
+        rs = eng.stats
+        print(f"router: routed {rs['routed']} per prefill target "
+              f"({rs['prefix_routed']} prefix-affine, "
+              f"{rs['prefix_match_tokens']} matched tokens), "
+              f"{rs['handoffs']} handoff(s) "
+              f"({rs['handoff_bytes'] / 1e6:.2f} MB page payloads, "
+              f"{rs['degraded_handoffs']} degraded), "
+              f"{rs['rejected']} rejected at the router")
+    if args.double_buffer:
+        print(f"double buffering: {s['decode_sync_s'] * 1e3:.1f}ms host "
+              f"time blocked on device syncs across "
+              f"{s['decode_calls']} decode dispatches")
     if args.speculate:
         acc = s["spec_accepted"] / max(s["spec_drafted"], 1)
         print(f"speculation (k={args.speculate}): {s['spec_drafted']} "

@@ -45,6 +45,17 @@ Composes four pieces:
     rejection sampling accepts the longest agreeing prefix plus one
     corrected token — token-for-token identical to non-speculative
     decode (``ServingEngine(spec_k=...)``);
+  * disaggregated multi-replica serving (r15):
+    :class:`~paddle_tpu.serving.router.Router` routes each request to
+    the replica with the longest cached prefix (read-only
+    ``prefix_match_len`` probes, load tie-break), separates prefill
+    workers from decode workers (``ServingEngine(role=...)`` + snapshot
+    v5 page-payload handoffs, layout-guarded, adopted bit-exactly into
+    the destination pool + prefix index), lifts WFQ virtual-token
+    counters router-global
+    (:class:`~paddle_tpu.serving.tenancy.ClusterWFQState`), and
+    ``double_buffer=True`` overlaps host scheduling of step N+1 with
+    the device's step N (``make_cluster`` builds the whole fleet);
   * fault tolerance (r10): on-demand page growth with
     preempt-and-recompute under pool pressure, per-request deadlines /
     ``cancel`` / bounded-queue backpressure,
@@ -60,24 +71,28 @@ See README "Serving" for the architecture and knobs;
 from .kv_pool import KVPool
 from .prefix_cache import PrefixIndex
 from .scheduler import Admission, FCFSScheduler, Request
-from .tenancy import (DEFAULT_TENANT, FCFSPolicy, SchedulerPolicy,
-                      TenantConfig, WFQPolicy)
+from .tenancy import (DEFAULT_TENANT, ClusterWFQState, FCFSPolicy,
+                      SchedulerPolicy, TenantConfig, WFQPolicy)
 from .metrics import (Counter, Gauge, Histogram, MetricsFileExporter,
-                      MetricsRegistry)
+                      MetricsRegistry, aggregate_scalars,
+                      cluster_prometheus)
 from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, TraceRecorder,
                       attach_profiler, detach_profiler)
 from .drafter import NGramDrafter
 from .engine import TERMINAL_REASONS, FinishedRequest, ServingEngine
 from .faults import FaultPlan, InjectedFault
-from .snapshot import restore_engine, snapshot_engine
+from .snapshot import handoff_state, restore_engine, snapshot_engine
 from .frontend import ServingFrontend
+from .router import Router, make_cluster
 
 __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "ServingEngine", "FinishedRequest", "TERMINAL_REASONS",
            "FaultPlan", "InjectedFault", "snapshot_engine",
-           "restore_engine", "MetricsRegistry", "Counter", "Gauge",
-           "Histogram", "MetricsFileExporter", "TraceRecorder",
+           "restore_engine", "handoff_state", "MetricsRegistry", "Counter",
+           "Gauge", "Histogram", "MetricsFileExporter", "TraceRecorder",
            "attach_profiler", "detach_profiler", "PID_ENGINE",
            "PID_REQUESTS", "PID_HOST",
            "SchedulerPolicy", "FCFSPolicy", "WFQPolicy", "TenantConfig",
-           "DEFAULT_TENANT", "ServingFrontend", "NGramDrafter"]
+           "ClusterWFQState", "DEFAULT_TENANT", "ServingFrontend",
+           "NGramDrafter", "Router", "make_cluster",
+           "aggregate_scalars", "cluster_prometheus"]

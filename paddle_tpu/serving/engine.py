@@ -235,6 +235,20 @@ class ServingEngine:
     and bills WFQ tenants by ACCEPTED tokens only.  Acceptance telemetry:
     ``stats["spec_drafted"/"spec_accepted"/"spec_rejected"]`` and the
     ``serving_spec_acceptance_rate`` per-request histogram.
+
+    r15 disaggregation knobs: ``role`` splits prefill from decode —
+    ``"prefill"`` engines run chunked prefill to completion, then export
+    every started slot as a handoff record (request + block-table-order
+    page payloads + quantization scales, snapshot v5 wire format) via
+    :meth:`drain_handoffs`; ``"decode"``/``"both"`` engines adopt the
+    pages bit-exactly through :meth:`ingest_handoff` (layout-guarded,
+    prompt pages re-indexed for prefix reuse, zero recompute).
+    :class:`~paddle_tpu.serving.router.Router` wires replicas together
+    with cache-affinity routing and router-global WFQ.
+    ``double_buffer=True`` defers the decode sync one step so the host
+    schedules step N+1 while step N runs on device —
+    ``stats["decode_sync_s"]`` shows the overlap win; incompatible with
+    ``spec_k`` (drafting needs the retired history).
     """
 
     def __init__(self, model, *, max_slots: int = 8, page_size: int = 32,
@@ -256,9 +270,28 @@ class ServingEngine:
                  on_token: Optional[Callable[[int, int], None]] = None,
                  spec_k: int = 0, spec_ngram: int = 3, drafter=None,
                  kv_bits: Optional[int] = None,
-                 attn_window: Optional[int] = None):
+                 attn_window: Optional[int] = None,
+                 role: str = "both", double_buffer: bool = False):
         cfg = model.cfg
         self.cfg = cfg
+        # r15 disaggregation: "prefill" engines run chunked prefill to
+        # completion and HAND OFF (request + page payload) instead of
+        # decoding; "decode" engines adopt handoffs into fresh pages and
+        # decode them; "both" (default) is the monolithic r08-r14 engine.
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got {role!r}")
+        self.role = role
+        # r15 double-buffered dispatch: defer the decode sync one step —
+        # step N's dispatched program runs on device while the host
+        # admits/prefills step N+1; finishes deliver one step late,
+        # greedy outputs are schedule-invariant so parity holds.
+        self.double_buffer = bool(double_buffer)
+        if self.double_buffer and spec_k:
+            raise ValueError(
+                "double_buffer is incompatible with speculative decoding "
+                "(spec_k > 0): drafting reads the retired token history "
+                "the deferred sync has not produced yet")
         # decode_block > 1 fuses that many decode steps into ONE dispatched
         # lax.scan (multi-step scheduling): admission/finish granularity
         # coarsens to the block, but the host->device dispatch latency —
@@ -382,7 +415,8 @@ class ServingEngine:
             policy=self.scheduler.policy.name,
             tenants=({t: dataclasses.asdict(c)
                       for t, c in normalize_tenants(tenants).items()}
-                     if tenants else None))
+                     if tenants else None),
+            role=role, double_buffer=self.double_buffer)
 
         # host mirrors of the decode step's device operands
         self._tokens_this_step = 0
@@ -397,6 +431,16 @@ class ServingEngine:
         # terminals produced OUTSIDE step() (reject at enqueue, cancel,
         # …) park here and are delivered by the next step()
         self._pending: List[FinishedRequest] = []
+        # r15 disaggregation queues: a prefill-role engine parks finished
+        # handoff records in the OUTBOX (the router pumps them away); a
+        # decode/both engine queues ingested records in the INBOX until a
+        # slot + pages free up.  Inbox payloads are host numpy — they
+        # hold no pool pages, so the leak audits are unaffected.
+        self._handoff_out: List[dict] = []
+        self._handoff_in: List[dict] = []
+        # r15 double-buffered dispatch: the un-retired decode future —
+        # ((slot, _Slot) pairs, remaining mirror, device tokens, t_dispatch)
+        self._inflight: Optional[tuple] = None
         self.stats = {"prefill_calls": 0, "decode_calls": 0,
                       "prefill_traces": 0, "decode_traces": 0,
                       "tokens_generated": 0,
@@ -407,8 +451,16 @@ class ServingEngine:
                       # so admit/prefill/decode no longer conflate into
                       # one step_wall_s bucket
                       "admit_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0,
+                      "handoff_s": 0.0,
                       "last_admit_s": 0.0, "last_prefill_s": 0.0,
-                      "last_decode_s": 0.0,
+                      "last_decode_s": 0.0, "last_handoff_s": 0.0,
+                      # host time actually BLOCKED on the decode sync —
+                      # under double_buffer the overlap win shows up as
+                      # this staying far below the dispatch wall time
+                      "decode_sync_s": 0.0, "last_decode_sync_s": 0.0,
+                      # disaggregation traffic (r15)
+                      "handoffs_out": 0, "handoffs_in": 0,
+                      "handoff_bytes": 0, "handoff_faults": 0,
                       "preemptions": 0, "recompute_tokens": 0,
                       "rejected": 0, "expired": 0, "cancelled": 0,
                       "step_faults": 0,
@@ -719,16 +771,53 @@ class ServingEngine:
                 self.stats["cancelled"] += 1
                 self._pending.append(self._finish(idx, "cancelled"))
                 return True
+        for i, rec in enumerate(self._handoff_in):
+            if rec["request"].rid == rid:
+                # queued for handoff admission: no slot, no pages — drop
+                # the record, terminalize with whatever was generated
+                del self._handoff_in[i]
+                self.stats["cancelled"] += 1
+                self._pending.append(
+                    self._terminal(rec["request"], "cancelled"))
+                return True
         return False
 
     @property
     def has_work(self) -> bool:
-        return self.scheduler.has_work or bool(self._pending)
+        """Work THIS engine can advance by stepping: queue/slots,
+        undelivered terminals, queued handoff ingests, or an un-retired
+        double-buffered dispatch.  The handoff OUTBOX is deliberately
+        excluded — draining it is the router's job, not a step's."""
+        return (self.scheduler.has_work or bool(self._pending)
+                or bool(self._handoff_in) or self._inflight is not None)
 
     def prefix_hit_rate(self) -> float:
         """Fraction of prompt tokens served from cached KV pages."""
         return self.stats["prefix_hit_tokens"] / max(
             self.stats["prompt_tokens"], 1)
+
+    # -- router probes (r15) ----------------------------------------------
+
+    def prefix_match_len(self, prompt) -> int:
+        """Tokens of ``prompt`` this replica's prefix index already holds
+        K/V for — the router's cache-affinity key.  Probes the WORK
+        prompt (``prompt[:-1]``, matching the scheduler's admission-time
+        lookup) and is strictly read-only: no LRU touch, no retain."""
+        if self.pool.prefix is None:
+            return 0
+        work = np.asarray(prompt, np.int32).reshape(-1)[:-1]
+        if work.size == 0:
+            return 0
+        return self.pool.prefix.probe_len(work)
+
+    def load_score(self) -> float:
+        """Scalar busyness for the router's tie-break: resident slots +
+        queue depth (both per capacity) + pool pressure.  Lower is
+        better; an idle replica scores ~0, a saturated one ~3."""
+        cap = max(self.max_slots, 1)
+        return (self.scheduler.n_active / cap
+                + self.scheduler.n_waiting / cap
+                + self.pool.utilization())
 
     def stats_snapshot(self) -> Dict[str, float]:
         """A COPY of the stats ledger at this instant.  ``engine.stats``
@@ -824,6 +913,24 @@ class ServingEngine:
                          "one chunk-prefill dispatch wall time"),
             "decode_call_s": h("serving_decode_call_s",
                                "one decode dispatch+sync wall time"),
+            "handoffs_out": c("serving_handoffs_out",
+                              "prefill-complete requests exported to the "
+                              "router (prefill-role engines)"),
+            "handoffs_in": c("serving_handoffs_in",
+                             "handoff records accepted from the router"),
+            "handoff_bytes": c("serving_handoff_bytes",
+                               "KV payload bytes shipped out (degraded "
+                               "transfers ship none)"),
+            "handoff_faults": c("serving_handoff_faults",
+                                "handoffs degraded by an injected "
+                                "transfer fault (payload dropped)"),
+            "handoff_inbox": g("serving_handoff_inbox",
+                               "ingested records waiting for a slot"),
+            "handoff_s": h("serving_step_handoff_s",
+                           "handoff export phase wall time"),
+            "decode_sync": h("serving_decode_sync_s",
+                             "host time blocked on the decode device "
+                             "sync (double buffering shrinks this)"),
         }
         return self.metrics
 
@@ -996,6 +1103,16 @@ class ServingEngine:
             if st is not None and st.request.expired(now):
                 self.stats["expired"] += 1
                 finished.append(self._finish(idx, "expired"))
+        if self._handoff_in:
+            keep = []
+            for rec in self._handoff_in:
+                if rec["request"].expired(now):
+                    self.stats["expired"] += 1
+                    finished.append(
+                        self._terminal(rec["request"], "expired"))
+                else:
+                    keep.append(rec)
+            self._handoff_in = keep
 
     def _admit(self, adm) -> None:
         """Apply one scheduling decision: build the slot's block table
@@ -1197,6 +1314,172 @@ class ServingEngine:
         self._table[idx, done:dead] = 0
         self.pool.free(victims)
 
+    # -- disaggregated prefill/decode handoff (r15) -----------------------
+
+    def _release_slot(self, idx: int) -> _Slot:
+        """Free slot ``idx`` WITHOUT a terminal — the handoff path: the
+        request lives on (on another replica), so no FinishedRequest, no
+        terminal counter; pages release normally (full prompt pages the
+        prefix index adopted park reclaimable for later local hits)."""
+        st = self._slots[idx]
+        self._slots[idx] = None
+        self._table[idx] = 0
+        self._tok[idx] = 0
+        self._len[idx] = 0
+        self.scheduler.release(idx, st.pages, st.request)
+        return st
+
+    def _handoff_started(self) -> None:
+        """Prefill-role drain: every STARTED slot (prompt complete, first
+        token sampled) serializes into a handoff record and leaves the
+        engine.  A scripted "handoff" fault degrades the WHOLE step's
+        transfers — records ship without page payloads and the decode
+        replica re-prefills them (chunked, prefix-cache-assisted), so a
+        dropped fabric costs recompute, never correctness."""
+        from .snapshot import handoff_state
+
+        started = sorted((i for i, s in enumerate(self._slots)
+                          if s is not None and s.started),
+                         key=lambda i: self._slots[i].seq)
+        if not started:
+            return
+        degraded = False
+        if self.faults is not None:
+            try:
+                self.faults.check_raise("handoff")
+            except InjectedFault:
+                degraded = True
+        for idx in started:
+            st = self._slots[idx]
+            h = handoff_state(self, idx, with_payload=not degraded)
+            self.stats["handoffs_out"] += 1
+            if degraded:
+                self.stats["handoff_faults"] += 1
+            else:
+                self.stats["handoff_bytes"] += h["nbytes"]
+            if self.tracer is not None:
+                rid = st.request.rid
+                self._tr_end(rid)            # the "resident" span
+                self.tracer.instant("handoff", PID_REQUESTS, rid,
+                                    {"n_pages": h["n_pages"],
+                                     "nbytes": h["nbytes"],
+                                     "degraded": degraded})
+            self._release_slot(idx)
+            self._handoff_out.append(h)
+
+    def drain_handoffs(self) -> List[dict]:
+        """Hand the outbox to the caller (the router's pump) — records
+        are the caller's to deliver once returned."""
+        out, self._handoff_out = self._handoff_out, []
+        return out
+
+    def ingest_handoff(self, h: dict) -> int:
+        """Accept one prefill-replica handoff record.  Layout-guarded
+        EAGERLY (a byte-incompatible payload must fail at the boundary,
+        not at admission); timestamps rebase onto this engine's clock
+        exactly like snapshot restore.  A payload-bearing record queues
+        in the inbox until a slot + pages free up; a DEGRADED record
+        (payload None) re-enters the waiting queue at the head — its
+        work prompt re-prefills here, recompute-style.  Returns the
+        rid."""
+        from .snapshot import _request_from_state
+
+        if self.role == "prefill":
+            raise ValueError(
+                "a prefill-role engine cannot ingest handoffs — route "
+                "them to a decode/both replica")
+        payload = h["payload"]
+        if payload is not None:
+            self.pool.check_layout(payload["layout"], what="handoff")
+        req = _request_from_state(h["request"])
+        delta = self._now() - float(h["clock_now"])
+        req.t_enqueue += delta
+        for attr in ("t_admitted", "t_first_token", "t_last_token"):
+            v = getattr(req, attr)
+            if v is not None:
+                setattr(req, attr, v + delta)
+        self.stats["handoffs_in"] += 1
+        if payload is None:
+            # degraded transfer: the request was already accepted and
+            # billed, so it bypasses backpressure and requeues at the
+            # head — uncharged_tokens()'s monotone high-water mark means
+            # the re-prefill bills the tenant nothing.  Accounting-wise
+            # this IS a preemption (the work prompt gets recomputed), so
+            # the re-admission lands in recompute_tokens like one.
+            req.n_preempted += 1
+            self.scheduler.requeue(req)
+            if self.tracer is not None:
+                self.tracer.begin("queued", PID_REQUESTS, req.rid,
+                                  {"recompute": True, "handoff": True})
+        else:
+            self._handoff_in.append(dict(
+                request=req, base_len=int(h["base_len"]),
+                n_pages=int(h["n_pages"]), payload=payload,
+                nbytes=int(h["nbytes"])))
+            if self.tracer is not None:
+                self.tracer.begin("queued", PID_REQUESTS, req.rid,
+                                  {"handoff": True})
+        return req.rid
+
+    def _admit_handoffs(self, finished: List[FinishedRequest]) -> None:
+        """Admit queued handoff records FIFO into free slots: lease
+        pages, scatter the payload in (bit-exact adoption — no
+        recompute), rebuild the slot mirrors as if local prefill had just
+        completed, and index the full prompt pages for prefix reuse.
+        Head-of-line blocking on slot/page shortage is intentional, same
+        as the scheduler's admission loop (a transient alloc fault just
+        retries next step — residents drain, so no livelock)."""
+        while self._handoff_in:
+            rec = self._handoff_in[0]
+            if not self._try_admit_handoff(rec):
+                break
+            self._handoff_in.pop(0)
+
+    def _try_admit_handoff(self, rec: dict) -> bool:
+        if not self.scheduler._free_slots:
+            return False
+        pages = self.pool.alloc(rec["n_pages"])
+        if pages is None:
+            return False
+        req = rec["request"]
+        base_len = rec["base_len"]
+        self.pool.ingest_pages(rec["payload"], pages)
+        if req.seq is None:      # carried from the prefill replica's
+            self._admit_seq += 1  # admission normally; None only if the
+            req.seq = self._admit_seq   # sender predates admission seqs
+        st = _Slot(req, pages, prefilled=base_len, seq=req.seq,
+                   base_len=base_len)
+        st.born_step = self._step_idx
+        st.started = True
+        slot = self.scheduler._free_slots.pop()
+        self.scheduler.note_restored_slot(req)
+        self._slots[slot] = st
+        row = np.zeros((self.max_pages,), np.int32)
+        row[:len(pages)] = pages
+        self._table[slot] = row
+        # mirrors exactly as local prefill completion leaves them: the
+        # carry token is the last sampled one, the device length is the
+        # work-prompt length whose K/V the pages hold
+        self._tok[slot] = req.generated[-1]
+        self._len[slot] = base_len
+        # adopt the full prompt pages into THIS pool's prefix index —
+        # same insert (and same windowed refusal) as local prefill; the
+        # indexable tokens are the base_len positions the pages actually
+        # hold, i.e. the work prompt minus the carry token
+        if self.pool.prefix is not None:
+            if self.window is not None and base_len > self.window:
+                self.pool.prefix.window_refusals += 1
+            else:
+                work = req.work_prompt()[:base_len]
+                nfull = base_len // self.page_size
+                self.pool.prefix.insert(work, st.pages[:nfull])
+        if self.tracer is not None:
+            self._tr_end(req.rid)            # the "queued" span
+            self.tracer.begin("resident", PID_REQUESTS, req.rid,
+                              {"slot": slot, "handoff": True,
+                               "adopted_pages": len(pages)})
+        return True
+
     def step(self) -> List[FinishedRequest]:
         """One engine iteration: expire deadlines, admit into freed
         slots, advance partial prefills by the chunk budget, grow decode
@@ -1233,7 +1516,7 @@ class ServingEngine:
         self.stats["queue_depth"] = self.scheduler.n_waiting
         self.stats["step_wall_s"] += dt
         self.stats["last_step_s"] = dt
-        for ph in ("admit", "prefill", "decode"):
+        for ph in ("admit", "prefill", "handoff", "decode"):
             start_dur = phase.get(ph)
             v = start_dur[1] if start_dur is not None else 0.0
             self.stats[f"{ph}_s"] += v
@@ -1264,8 +1547,13 @@ class ServingEngine:
                                ("step_faults", "step_faults"),
                                ("spec_drafted", "spec_drafted"),
                                ("spec_accepted", "spec_accepted"),
-                               ("spec_rejected", "spec_rejected")):
+                               ("spec_rejected", "spec_rejected"),
+                               ("handoffs_out", "handoffs_out"),
+                               ("handoffs_in", "handoffs_in"),
+                               ("handoff_bytes", "handoff_bytes"),
+                               ("handoff_faults", "handoff_faults")):
             m[name].set_total(s[stat_key])
+        m["handoff_inbox"].set(len(self._handoff_in))
         m["alloc_calls"].set_total(self.pool.alloc_calls)
         m["alloc_failures"].set_total(self.pool.alloc_failures)
         if self.pool.prefix is not None:
@@ -1283,7 +1571,7 @@ class ServingEngine:
         m["budget_util"].set(self._tokens_this_step
                              / max(self.scheduler.token_budget, 1))
         m["step_s"].observe(dt)
-        for ph in ("admit", "prefill", "decode"):
+        for ph in ("admit", "prefill", "handoff", "decode"):
             if ph in phase:
                 m[f"{ph}_s"].observe(phase[ph][1])
 
@@ -1292,6 +1580,10 @@ class ServingEngine:
         t_a = time.perf_counter()
         try:
             self._expire(finished)
+            # handoff ingests admit FIRST: their prefill is already paid
+            # for, so they take priority over raw admissions for the
+            # slots/pages this step frees up
+            self._admit_handoffs(finished)
             for adm in self.scheduler.schedule_step():
                 self._admit(adm)
             self._fault_point("admit")
@@ -1304,6 +1596,18 @@ class ServingEngine:
         finally:
             phase["prefill"] = (t_p, time.perf_counter() - t_p)
 
+        if self.role == "prefill":
+            # prefill workers never decode: every slot that completed its
+            # prompt this step exports (request, block-table order pages,
+            # payload + scales) and frees its slot — the router delivers
+            # the records to a decode replica
+            t_h = time.perf_counter()
+            try:
+                self._handoff_started()
+            finally:
+                phase["handoff"] = (t_h, time.perf_counter() - t_h)
+            return
+
         t_d = time.perf_counter()
         try:
             self._decode_step(finished)
@@ -1314,6 +1618,12 @@ class ServingEngine:
     def _decode_step(self, finished: List[FinishedRequest]) -> None:
         if self.spec_k:
             return self._spec_decode_step(finished)
+        # retire LAST step's dispatched decode FIRST (double-buffer mode
+        # leaves it un-synced so admit/prefill overlap the device): its
+        # finishes free pages before this step's growth asks for them,
+        # and growth can therefore never preempt an un-retired slot
+        if self._inflight is not None:
+            self._retire_decode(finished)
         # decode-page growth, oldest first so preemption victims are
         # always younger than the grower
         order = sorted((i for i, s in enumerate(self._slots)
@@ -1337,45 +1647,72 @@ class ServingEngine:
                 jnp.asarray(self._len), jnp.asarray(self._table),
                 jnp.asarray(remaining), self._next_key())
             self.stats["decode_calls"] += 1
-            toks_all = np.asarray(toks_all)                # (k, max_slots)
-            if self.metrics is not None:
-                # np.asarray synced the dispatch, so this is the real
-                # device step time, not the async hand-off
-                self._m["decode_call_s"].observe(time.perf_counter() - t_c)
-            now = self._now()
-            for idx in run:
-                st = self._slots[idx]
-                consumed = int(min(self.decode_block, remaining[idx]))
-                reason = None
-                n_new = 0
-                req = st.request
-                for i in range(consumed):
-                    tok = int(toks_all[i, idx])
-                    st.tokens.append(tok)
-                    self._emit_token(req, tok)
-                    n_new += 1
-                    self.stats["tokens_generated"] += 1
-                    if (self.eos_token_id is not None
-                            and tok == self.eos_token_id):
-                        reason = "eos"
-                        break
-                self._tokens_this_step += n_new
-                self._charge_service(req)
-                if (self.metrics is not None and n_new
-                        and req.t_last_token is not None):
-                    self._m["tbt"].observe((now - req.t_last_token) / n_new)
-                req.t_last_token = now
-                if reason is None and (len(st.tokens)
-                                       >= st.request.max_new_tokens):
-                    reason = "length"
-                if reason is not None:
-                    finished.append(self._finish(idx, reason))
-                else:
-                    # mirror the DEVICE state: it advanced `consumed` steps
-                    # and its carry token is the last sampled one
-                    self._tok[idx] = int(toks_all[consumed - 1, idx])
-                    self._len[idx] += consumed
-                    self._recycle_window_pages(idx)
+            # stash the DISPATCHED call without syncing; slot objects ride
+            # along so retirement can detect cancel/expire/slot-reuse
+            self._inflight = ([(idx, self._slots[idx]) for idx in run],
+                              remaining, toks_all, t_c)
+            if not self.double_buffer:
+                self._retire_decode(finished)
+
+    def _retire_decode(self, finished: List[FinishedRequest]) -> None:
+        """Sync the stashed decode dispatch and apply its results: append
+        tokens, bill tenants, finish eos/length, mirror carry state.  In
+        double-buffer mode this runs one step LATE — the host scheduled
+        step N+1's admissions and prefill while step N's program ran on
+        device — so finishes surface a step later, which greedy outputs
+        (schedule-invariant per request) don't observe."""
+        entries, remaining, toks_all, t_c = self._inflight
+        self._inflight = None
+        t_s = time.perf_counter()
+        toks_all = np.asarray(jax.block_until_ready(toks_all))
+        sync_s = time.perf_counter() - t_s
+        self.stats["decode_sync_s"] += sync_s
+        self.stats["last_decode_sync_s"] = sync_s
+        if self.metrics is not None:
+            # block_until_ready closed the dispatch, so this is the real
+            # device step time, not the async hand-off; sync_s is the
+            # part the host actually WAITED — overlap makes it shrink
+            self._m["decode_call_s"].observe(time.perf_counter() - t_c)
+            self._m["decode_sync"].observe(sync_s)
+        now = self._now()
+        for idx, st_dispatched in entries:
+            st = self._slots[idx]
+            if st is not st_dispatched:
+                # slot was cancelled/expired (or re-used by a fresh
+                # admission) between dispatch and retirement — its
+                # sampled tokens are dead, drop them on the floor
+                continue
+            consumed = int(min(self.decode_block, remaining[idx]))
+            reason = None
+            n_new = 0
+            req = st.request
+            for i in range(consumed):
+                tok = int(toks_all[i, idx])
+                st.tokens.append(tok)
+                self._emit_token(req, tok)
+                n_new += 1
+                self.stats["tokens_generated"] += 1
+                if (self.eos_token_id is not None
+                        and tok == self.eos_token_id):
+                    reason = "eos"
+                    break
+            self._tokens_this_step += n_new
+            self._charge_service(req)
+            if (self.metrics is not None and n_new
+                    and req.t_last_token is not None):
+                self._m["tbt"].observe((now - req.t_last_token) / n_new)
+            req.t_last_token = now
+            if reason is None and (len(st.tokens)
+                                   >= st.request.max_new_tokens):
+                reason = "length"
+            if reason is not None:
+                finished.append(self._finish(idx, reason))
+            else:
+                # mirror the DEVICE state: it advanced `consumed` steps
+                # and its carry token is the last sampled one
+                self._tok[idx] = int(toks_all[consumed - 1, idx])
+                self._len[idx] += consumed
+                self._recycle_window_pages(idx)
 
     def _spec_decode_step(self, finished: List[FinishedRequest]) -> None:
         """One speculative iteration over the started slots: draft from
@@ -1496,6 +1833,17 @@ class ServingEngine:
             raise AssertionError(
                 f"rid(s) {sorted(both)} simultaneously waiting and "
                 "resident in a slot")
+        # handoff inbox (r15): ingested-but-unadmitted records hold NO
+        # pool pages here (their payload is host memory until admission),
+        # and their rids must collide with neither queue nor slots
+        inbox_rids = [rec["request"].rid for rec in self._handoff_in]
+        if len(inbox_rids) != len(set(inbox_rids)):
+            raise AssertionError("duplicate rid in the handoff inbox")
+        clash = set(inbox_rids) & (set(waiting_rids) | slot_rids)
+        if clash:
+            raise AssertionError(
+                f"rid(s) {sorted(clash)} in the handoff inbox AND "
+                "waiting/resident")
         free = set(self.scheduler._free_slots)
         for i, s in enumerate(self._slots):
             if (i in free) == (s is not None):

@@ -48,7 +48,14 @@ import numpy as np
 #: are proposed and pages grown, before the verify dispatch — so chaos
 #: runs exercise the draft-buffers-populated-but-unverified state; a
 #: non-speculative engine never reaches it (the fault stays silent).
-PHASES = ("admit", "prefill", "verify", "decode")
+#: "handoff" (r15) fires in a PREFILL-role engine's handoff phase and
+#: models the transfer fabric dropping that step's page payloads: the
+#: handoff DEGRADES (the request ships without KV and re-prefills on the
+#: decode replica) instead of aborting the step, so disaggregated chaos
+#: runs exercise the recompute fallback.  Engines that never hand off
+#: (role "both"/"decode") never reach it — the fault stays silent, like
+#: "verify" on a non-speculative engine.
+PHASES = ("admit", "prefill", "handoff", "verify", "decode")
 
 
 class InjectedFault(RuntimeError):

@@ -70,7 +70,12 @@ class _BadRequest(Exception):
 
 
 class ServingFrontend:
-    """Asyncio HTTP server over one :class:`ServingEngine`.
+    """Asyncio HTTP server over one :class:`ServingEngine` — or over a
+    :class:`~paddle_tpu.serving.router.Router` (r15): anything with the
+    engine's driving surface (``add_request`` / ``cancel`` / ``step`` /
+    ``has_work`` / ``on_token``) serves; a Router is detected by its
+    ``replicas`` attribute, ``/healthz`` then aggregates the fleet and
+    ``/metrics`` renders the replica-labeled cluster scrape page.
 
     ``port=0`` binds an ephemeral port (read ``frontend.port`` after
     :meth:`start` — the test client does).  The ctor chains onto any
@@ -81,6 +86,9 @@ class ServingFrontend:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  idle_sleep_s: float = 0.002, max_tenants: int = 256):
         self.engine = engine
+        # a Router drives like an engine; only observability and the
+        # backpressure probe need to know there is a fleet behind it
+        self._is_cluster = hasattr(engine, "replicas")
         self.host = host
         self.port = port
         self.idle_sleep_s = idle_sleep_s
@@ -95,12 +103,25 @@ class ServingFrontend:
         self._server: Optional[asyncio.AbstractServer] = None
         self._driver: Optional[asyncio.Task] = None
         self._driver_error: Optional[BaseException] = None
-        if engine.metrics is None:
-            engine.attach_metrics()
-        self._http_requests = lambda route, code: engine.metrics.counter(
-            "serving_http_requests", "front-end requests by route/status",
-            labels={"route": route, "code": str(code)})
-        self._streams_open = engine.metrics.gauge(
+        if self._is_cluster:
+            from .metrics import MetricsRegistry
+
+            # per-replica registries stay per-replica (the engine's
+            # one-registry rule); HTTP-surface series live in their own
+            # registry, concatenated onto the cluster scrape page
+            if engine._parts is None:
+                engine.attach_metrics()
+            self._http_registry = MetricsRegistry()
+        else:
+            if engine.metrics is None:
+                engine.attach_metrics()
+            self._http_registry = engine.metrics
+        self._http_requests = \
+            lambda route, code: self._http_registry.counter(
+                "serving_http_requests",
+                "front-end requests by route/status",
+                labels={"route": route, "code": str(code)})
+        self._streams_open = self._http_registry.gauge(
             "serving_http_streams_open", "SSE streams currently open")
         self._prev_on_token = engine.on_token
 
@@ -256,21 +277,46 @@ class ServingFrontend:
         if method == "GET" and path == "/healthz":
             eng = self.engine
             dead = self._driver_error is not None
-            payload = json.dumps({
-                "status": "driver dead" if dead else "ok",
-                "error": repr(self._driver_error) if dead else None,
-                "step": eng._step_idx,
-                "queue_depth": eng.scheduler.n_waiting,
-                "slots_active": eng.scheduler.n_active,
-                "slots_total": eng.max_slots,
-                "pages_in_use": eng.pool.pages_in_use,
-                "pages_free": eng.pool.num_free,
-                "policy": eng.scheduler.policy.name,
-            }).encode()
+            if self._is_cluster:
+                reps = eng.replicas
+                payload = json.dumps({
+                    "status": "driver dead" if dead else "ok",
+                    "error": repr(self._driver_error) if dead else None,
+                    "replicas": len(reps),
+                    "roles": [r.role for r in reps],
+                    "step": max(r._step_idx for r in reps),
+                    "queue_depth": eng.queue_depth,
+                    "slots_active": sum(r.scheduler.n_active
+                                        for r in reps),
+                    "slots_total": sum(r.max_slots for r in reps),
+                    "pages_in_use": sum(r.pool.pages_in_use
+                                        for r in reps),
+                    "pages_free": sum(r.pool.num_free for r in reps),
+                    "policy": reps[0].scheduler.policy.name,
+                }).encode()
+            else:
+                payload = json.dumps({
+                    "status": "driver dead" if dead else "ok",
+                    "error": repr(self._driver_error) if dead else None,
+                    "step": eng._step_idx,
+                    "queue_depth": eng.scheduler.n_waiting,
+                    "slots_active": eng.scheduler.n_active,
+                    "slots_total": eng.max_slots,
+                    "pages_in_use": eng.pool.pages_in_use,
+                    "pages_free": eng.pool.num_free,
+                    "policy": eng.scheduler.policy.name,
+                }).encode()
             await self._send(writer, "/healthz", 503 if dead else 200,
                              payload)
         elif method == "GET" and path == "/metrics":
-            text = self.engine.metrics.to_prometheus().encode()
+            if self._is_cluster:
+                # replica-labeled fleet page + the HTTP-surface series
+                # (distinct families, so concatenation stays one valid
+                # exposition page)
+                text = (self.engine.to_prometheus()
+                        + self._http_registry.to_prometheus()).encode()
+            else:
+                text = self.engine.metrics.to_prometheus().encode()
             await self._send(writer, "/metrics", 200, text,
                              ctype="text/plain; version=0.0.4")
         elif method == "POST" and path == "/v1/completions":
@@ -321,6 +367,14 @@ class ServingFrontend:
 
     def _overloaded(self, tenant: Optional[str]) -> bool:
         eng = self.engine
+        if self._is_cluster:
+            if (eng.max_queue is not None
+                    and eng.queue_depth >= eng.max_queue):
+                return True
+            # with a shared ClusterWFQState any member answers for the
+            # whole fleet; without one, quotas are per-replica and the
+            # first prefill target is where this request would land-ish
+            return eng.prefill_targets[0].scheduler.quota_reject(tenant)
         if (eng.max_queue is not None
                 and eng.scheduler.n_waiting >= eng.max_queue):
             return True

@@ -41,6 +41,8 @@ import math
 from collections import Counter
 from typing import Dict, List, Optional
 
+import numpy as np
+
 import jax.numpy as jnp
 
 from .prefix_cache import PrefixIndex
@@ -198,6 +200,74 @@ class KVPool:
     # retain/free bracket one REFERENCE; `release` reads better at call
     # sites that drop a whole lease
     release = free
+
+    # -- page payload transfer (r15 disaggregation) -----------------------
+
+    def export_pages(self, pages: List[int]) -> Dict[str, object]:
+        """Serialize the K/V bytes of ``pages`` (in the given block-table
+        order) as host numpy — the disaggregated prefill→decode handoff
+        payload, using the same per-buffer numpy-copy shape as snapshot
+        v5's pool section, so quantized pages travel WITH their fp32
+        scale planes automatically (``ks``/``vs`` are just more buffers).
+        The payload embeds :meth:`layout`; :meth:`ingest_pages` on the
+        receiving pool refuses a mismatch."""
+        idx = [int(p) for p in pages]
+        for p in idx:
+            self._check_page(p)
+        return {
+            "layout": self.layout(),
+            "buffers": {k: np.asarray(v[:, idx]).copy()
+                        for k, v in self.buffers.items()},
+        }
+
+    @staticmethod
+    def payload_nbytes(payload: Dict[str, object]) -> int:
+        """Wire size of an :meth:`export_pages` payload (page bytes +
+        scale planes; the layout dict is negligible)."""
+        return sum(int(a.nbytes) for a in payload["buffers"].values())
+
+    def check_layout(self, want: Dict[str, object],
+                     what: str = "page payload") -> None:
+        """Refuse a foreign KV layout loudly, with the per-key diff —
+        the same guard shape snapshot restore uses: mixed layouts would
+        reinterpret page bytes silently (wrong dtype, wrong head count,
+        wrong nibble packing), which is strictly worse than failing."""
+        have = self.layout()
+        if have != want:
+            diff = {k: (want.get(k), have.get(k))
+                    for k in set(want) | set(have)
+                    if have.get(k) != want.get(k)}
+            raise ValueError(
+                f"{what} KV layout does not match this pool — sender vs "
+                f"receiver: {diff}; prefill and decode replicas must "
+                "share kv heads, page dtype, kv_bits, window and page "
+                "geometry for pages to be byte-compatible")
+
+    def ingest_pages(self, payload: Dict[str, object],
+                     pages: List[int]) -> None:
+        """Adopt an :meth:`export_pages` payload into freshly leased
+        ``pages`` (same order).  Layout-guarded; the scatter is a plain
+        eager ``.at[].set`` per buffer, so the round-trip
+        export→host→ingest is bit-exact for fp, int8 and nibble-packed
+        int4 pages and their scales alike."""
+        self.check_layout(payload["layout"])
+        bufs = payload["buffers"]
+        if set(bufs) != set(self.buffers):
+            raise ValueError(
+                f"payload buffers {sorted(bufs)} != pool buffers "
+                f"{sorted(self.buffers)}")
+        idx = [int(p) for p in pages]
+        for p in idx:
+            self._check_page(p)
+        n = len(idx)
+        rows = jnp.asarray(idx, jnp.int32)
+        for name, arr in bufs.items():
+            if arr.shape[1] != n:
+                raise ValueError(
+                    f"payload buffer {name!r} carries {arr.shape[1]} "
+                    f"pages for a {n}-page lease")
+            self.buffers[name] = self.buffers[name].at[:, rows].set(
+                jnp.asarray(arr))
 
     # -- invariants -------------------------------------------------------
 

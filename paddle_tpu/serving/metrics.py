@@ -434,3 +434,75 @@ class MetricsFileExporter:
 
     def __exit__(self, *a) -> None:
         self.close()
+
+
+# -- multi-replica aggregation (r15) ----------------------------------------
+#
+# One engine per registry is a hard rule (set_total mirroring), so a
+# routed fleet holds a DICT of registries — {"replica0": reg, ...} from
+# Router.attach_metrics().  These two functions are the sanctioned ways
+# to read that dict as one thing: a labeled scrape page, or a rolled-up
+# scalar table.
+
+
+def cluster_prometheus(parts: Dict[str, "MetricsRegistry"]) -> str:
+    """One Prometheus scrape page over per-replica registries: every
+    series gains a ``replica="<key>"`` label, and every family still
+    renders contiguously under a single HELP/TYPE header (strict parsers
+    reject split families).  Replica keys iterate sorted, so the page is
+    deterministic for a given fleet state."""
+    import copy
+
+    families: Dict[str, List] = {}
+    for rep in sorted(parts):
+        for m in parts[rep]._metrics.values():
+            mm = copy.copy(m)
+            mm.labels = {**m.labels, "replica": str(rep)}
+            families.setdefault(m.name, []).append(mm)
+    lines: List[str] = []
+    for fam in families.values():
+        name = _sanitize(fam[0].name)
+        helps = [m.help for m in fam if m.help]
+        if helps:
+            lines.append(f"# HELP {name} {helps[0]}")
+        lines.append(f"# TYPE {name} {fam[0].kind}")
+        for m in fam:
+            lines.extend(MetricsRegistry._prom_series(name, m))
+    return "\n".join(lines) + "\n"
+
+
+def aggregate_scalars(parts: Dict[str, "MetricsRegistry"]
+                      ) -> Dict[str, float]:
+    """Cluster rollup of per-replica ``scalars()``: counters, gauges and
+    histogram ``_count``/``_sum`` tags SUM across replicas; ``_min`` /
+    ``_max`` combine by min/max; ``_mean`` recomputes from the summed
+    totals.  Per-replica quantiles (``_p50``/``_p90``/``_p99``) are
+    DROPPED — order statistics don't aggregate, and a made-up "cluster
+    p99" would be worse than none.  Ratio gauges (hit rate, budget
+    utilization) sum like any gauge: divide by the replica count, or
+    read the per-replica registries, when you want the level."""
+    out: Dict[str, float] = {}
+    mins: Dict[str, float] = {}
+    maxs: Dict[str, float] = {}
+    for reg in parts.values():
+        for tag, v in reg.scalars().items():
+            stem = tag.split(".", 1)[0]
+            if stem.endswith(("_p50", "_p90", "_p99", "_mean")):
+                continue
+            if stem.endswith("_min"):
+                mins[tag] = v if tag not in mins else min(mins[tag], v)
+            elif stem.endswith("_max"):
+                maxs[tag] = v if tag not in maxs else max(maxs[tag], v)
+            else:
+                out[tag] = out.get(tag, 0.0) + v
+    out.update(mins)
+    out.update(maxs)
+    for tag in list(out):
+        stem, dot, lbl = tag.partition(".")
+        if stem.endswith("_count") and out[tag]:
+            base = stem[:-len("_count")]
+            sfx = (dot + lbl) if dot else ""
+            sum_tag = base + "_sum" + sfx
+            if sum_tag in out:
+                out[base + "_mean" + sfx] = out[sum_tag] / out[tag]
+    return out

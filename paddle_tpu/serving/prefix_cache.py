@@ -122,6 +122,29 @@ class PrefixIndex:
             self._touch(node)
         return pages, partial
 
+    def probe_len(self, tokens) -> int:
+        """Longest cached prefix of ``tokens`` in TOKENS, read-only: the
+        same walk as :meth:`match` (full page-aligned chain plus the best
+        partial tail) but touching neither the LRU ticks nor refcounts —
+        this is how a replica exposes its prefix-index keys to the
+        multi-replica router (r15), which probes EVERY replica per
+        request and must not distort the caches it merely inspected."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.page_size
+        node, i = self.root, 0
+        while i + ps <= toks.size:
+            child = node.children.get(toks[i:i + ps].tobytes())
+            if child is None:
+                break
+            node = child
+            i += ps
+        rest = toks[i:]
+        best_m = 0
+        if rest.size:
+            for child in node.children.values():
+                best_m = max(best_m, self._common_prefix(rest, child.chunk))
+        return i + best_m
+
     # -- insertion --------------------------------------------------------
 
     def insert(self, tokens, pages: Sequence[int]) -> List[int]:

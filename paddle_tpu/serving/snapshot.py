@@ -65,6 +65,13 @@ from .scheduler import Request
 #: carry ``hw_pages`` (windowed-recycling high-water mark); older
 #: snapshots default it to the live page count (exact: they predate
 #: recycling, so the two never diverged).
+#: r15 (disaggregation) rides on v5 with OPTIONAL keys: the config echo
+#: carries ``role``/``double_buffer`` (older snapshots restore as a
+#: monolithic synchronous engine), the engine section carries the
+#: handoff inbox/outbox (absent = empty), and :func:`handoff_state`
+#: reuses the v5 pool-serialization shapes as the prefill→decode WIRE
+#: format — an in-flight double-buffered dispatch is retired before
+#: capture, so a snapshot never holds a live device future.
 SNAPSHOT_VERSION = 5
 _READABLE_VERSIONS = (2, 3, 4, 5)
 
@@ -110,9 +117,39 @@ def _finished_state(fin) -> dict:
                 finish_reason=fin.finish_reason, n_steps=fin.n_steps)
 
 
+def handoff_state(eng, idx: int, with_payload: bool = True) -> dict:
+    """The disaggregated prefill→decode handoff record for slot ``idx``
+    of a prefill-role engine (r15): the request's full lifecycle state
+    (generated already includes the first sampled token — the decode
+    replica's carry), the slot's page payload in block-table order via
+    ``KVPool.export_pages`` (snapshot-v5 pool serialization; layout
+    embedded, enforced on ingest), and the source engine clock so the
+    receiver rebases timestamps exactly like a snapshot restore does.
+    ``with_payload=False`` is the DEGRADED form (handoff-phase fault):
+    the request ships without KV and re-prefills on the decode replica —
+    greedy output is unchanged, only the recompute is paid again."""
+    st = eng._slots[idx]
+    payload = eng.pool.export_pages(st.pages) if with_payload else None
+    return {
+        "version": SNAPSHOT_VERSION,
+        "request": _request_state(st.request),
+        "base_len": int(st.base_len),
+        "n_pages": len(st.pages),
+        "payload": payload,
+        "nbytes": (eng.pool.payload_nbytes(payload)
+                   if payload is not None else 0),
+        "clock_now": float(eng._now()),
+    }
+
+
 def snapshot_engine(eng) -> dict:
     """Capture ``eng`` (a :class:`~paddle_tpu.serving.engine.ServingEngine`)
     as a plain-python dict; see the module docstring for the contract."""
+    # double-buffered dispatch (r15): an un-retired decode future is
+    # device state a snapshot cannot carry — sync and process it first
+    # (its finishes land in _pending, delivered by the restored engine)
+    if getattr(eng, "_inflight", None) is not None:
+        eng._retire_decode(eng._pending)
     slots = []
     for st in eng._slots:
         if st is None:
@@ -141,7 +178,17 @@ def snapshot_engine(eng) -> dict:
             # intervals over — raw time.monotonic values are meaningless
             # across a process boundary (per-boot base)
             clock_now=float(eng._now()),
-            pending=[_finished_state(f) for f in eng._pending]),
+            pending=[_finished_state(f) for f in eng._pending],
+            # r15 handoff queues: inbox records re-serialize their live
+            # Request; outbox entries are already wire dicts (numpy
+            # payloads) — both restore with clock rebasing
+            handoff_in=[dict(request=_request_state(r["request"]),
+                             base_len=int(r["base_len"]),
+                             n_pages=int(r["n_pages"]),
+                             payload=r["payload"],
+                             nbytes=int(r["nbytes"]))
+                        for r in eng._handoff_in],
+            handoff_out=[dict(h) for h in eng._handoff_out]),
         "scheduler": dict(
             waiting=[_request_state(r) for r in eng.scheduler.waiting],
             free_slots=list(eng.scheduler._free_slots),
@@ -259,6 +306,29 @@ def restore_engine(model, snap: dict, **overrides):
     eng._table = np.asarray(es["table"], np.int32).copy()
     eng.stats.update(es["stats"])
     eng._pending = [FinishedRequest(**f) for f in es["pending"]]
+    # r15 handoff queues (absent in older snapshots = empty): inbox
+    # requests rebase like waiting ones; outbox wire dicts rebase their
+    # embedded request timestamps AND their source-clock reading, so a
+    # later ingest on another replica computes the same relative delta
+    eng._handoff_in = []
+    for rec in es.get("handoff_in", ()):
+        req = _request_from_state(rec["request"])
+        _rebase(req)
+        eng._handoff_in.append(dict(
+            request=req, base_len=int(rec["base_len"]),
+            n_pages=int(rec["n_pages"]), payload=rec["payload"],
+            nbytes=int(rec["nbytes"])))
+    eng._handoff_out = []
+    for h in es.get("handoff_out", ()):
+        h = dict(h)
+        rq = dict(h["request"])
+        rq["t_enqueue"] = float(rq["t_enqueue"]) + delta
+        for key in ("t_admitted", "t_first_token", "t_last_token"):
+            if rq.get(key) is not None:
+                rq[key] = float(rq[key]) + delta
+        h["request"] = rq
+        h["clock_now"] = float(h["clock_now"]) + delta
+        eng._handoff_out.append(h)
     if snap.get("metrics") is not None and "metrics" not in overrides:
         from .metrics import MetricsRegistry
 

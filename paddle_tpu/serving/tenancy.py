@@ -49,7 +49,8 @@ from dataclasses import asdict, dataclass
 from typing import Deque, Dict, Iterable, Iterator, List, Optional, Union
 
 __all__ = ["DEFAULT_TENANT", "TenantConfig", "SchedulerPolicy",
-           "FCFSPolicy", "WFQPolicy", "normalize_tenants", "make_policy"]
+           "FCFSPolicy", "WFQPolicy", "ClusterWFQState",
+           "normalize_tenants", "make_policy"]
 
 #: Requests carrying no tenant name account under this one.
 DEFAULT_TENANT = "default"
@@ -217,20 +218,57 @@ class FCFSPolicy(SchedulerPolicy):
         return iter(self.queue)
 
 
+class ClusterWFQState(object):
+    """Router-global WFQ ledger (r15): ONE virtual-counter dict + tenant
+    config map shared by every replica's :class:`WFQPolicy` in a
+    multi-replica cluster, plus the member list that makes activity and
+    quota checks cluster-wide.  Each member policy still owns its LOCAL
+    queue and residency (a request waits/runs on exactly one replica),
+    but ``charge`` lands on the shared counters — so
+    ``vt[tenant] == total served tokens / weight`` ACROSS the cluster,
+    and tenant fairness holds no matter which replica served the tokens.
+    Build one state, pass ``WFQPolicy(state=...)`` per engine
+    (``serving.router.make_cluster`` does this wiring)."""
+
+    def __init__(self, tenants=None):
+        self.tenants: Dict[str, TenantConfig] = normalize_tenants(tenants)
+        self.vt: Dict[str, float] = {}
+        self.members: List["WFQPolicy"] = []
+
+
 class WFQPolicy(SchedulerPolicy):
     """Weighted fair queueing over per-tenant virtual token counters.
 
     ``tenants`` maps tenant name -> :class:`TenantConfig` (or a bare
     weight number); tenants not named get ``TenantConfig()`` lazily on
     first arrival, so the policy never rejects an unknown tenant — it
-    just shares at weight 1."""
+    just shares at weight 1.
+
+    ``state`` (r15) plugs this policy into a shared
+    :class:`ClusterWFQState`: counters and tenant configs ALIAS the
+    shared dicts, and activity / idle-lift / quota checks consider every
+    member replica — a tenant busy on replica A is not "idle" (no unfair
+    counter lift) and not under-quota (no double admission) on replica
+    B.  A standalone policy is just a one-member cluster, so the r12
+    single-engine semantics are unchanged."""
 
     name = "wfq"
 
-    def __init__(self, tenants=None):
-        self.tenants: Dict[str, TenantConfig] = normalize_tenants(tenants)
+    def __init__(self, tenants=None, state: Optional[ClusterWFQState] = None):
+        self._state = state
+        if state is not None:
+            if tenants:
+                raise ValueError(
+                    "pass tenants to the ClusterWFQState, not to member "
+                    "policies — one config map per cluster")
+            # alias, don't copy: every member reads/writes the ONE ledger
+            self.tenants = state.tenants
+            self.vt = state.vt
+            state.members.append(self)
+        else:
+            self.tenants = normalize_tenants(tenants)
+            self.vt = {}                     # served tokens / weight
         self.queues: Dict[str, Deque] = {}
-        self.vt: Dict[str, float] = {}       # served tokens / weight
         self.resident: Dict[str, int] = {}   # requests currently in slots
 
     # -- helpers ----------------------------------------------------------
@@ -253,10 +291,25 @@ class WFQPolicy(SchedulerPolicy):
             self.resident.setdefault(tenant, 0)
         return q
 
+    def _peers(self) -> List["WFQPolicy"]:
+        """Every policy sharing this ledger (just self when standalone):
+        activity, lifts and quotas are judged over the whole cluster."""
+        return self._state.members if self._state is not None else [self]
+
     def _active(self, tenant: str) -> bool:
-        """Waiting or resident work — the tenant is consuming/contending."""
-        return bool(self.queues.get(tenant)) or \
-            self.resident.get(tenant, 0) > 0
+        """Waiting or resident work ANYWHERE in the cluster — the tenant
+        is consuming/contending."""
+        return any(bool(p.queues.get(tenant))
+                   or p.resident.get(tenant, 0) > 0
+                   for p in self._peers())
+
+    def _resident_total(self, tenant: str) -> int:
+        """Cluster-wide slots the tenant holds (max_resident quota)."""
+        return sum(p.resident.get(tenant, 0) for p in self._peers())
+
+    def _waiting_total(self, tenant: str) -> int:
+        """Cluster-wide queue depth for the tenant (max_waiting quota)."""
+        return sum(len(p.queues.get(tenant, ())) for p in self._peers())
 
     def _eligible(self) -> Optional[str]:
         """The tenant whose queue head admits next: highest priority
@@ -269,7 +322,7 @@ class WFQPolicy(SchedulerPolicy):
                 continue
             cfg = self.config(t)
             if cfg.max_resident is not None and \
-                    self.resident.get(t, 0) >= cfg.max_resident:
+                    self._resident_total(t) >= cfg.max_resident:
                 continue
             key = (-cfg.priority, self.vt.get(t, 0.0), t)
             if best is None or key < best[0]:
@@ -289,9 +342,15 @@ class WFQPolicy(SchedulerPolicy):
             # weapon.  (Never lowered: a tenant ahead of the pack stays
             # ahead by exactly its surplus.)  Active spans queued AND
             # resident-only tenants — after a snapshot restore a tenant
-            # can be fully in slots with no queue entry yet.
-            active = [self.vt.get(u, 0.0)
-                      for u in set(self.queues) | set(self.resident)
+            # can be fully in slots with no queue entry yet.  Under a
+            # shared cluster ledger "active" and the candidate set span
+            # every member replica: a tenant mid-flight on another
+            # replica both blocks the lift for itself and anchors it for
+            # others.
+            names = set()
+            for p in self._peers():
+                names |= set(p.queues) | set(p.resident)
+            active = [self.vt.get(u, 0.0) for u in names
                       if u != t and self._active(u)]
             if active:
                 self.vt[t] = max(self.vt.get(t, 0.0), min(active))
@@ -344,10 +403,12 @@ class WFQPolicy(SchedulerPolicy):
     def quota_reject(self, tenant: Optional[str]) -> bool:
         t = tenant or DEFAULT_TENANT
         # read-only: a rejected arrival must not mint permanent tenant
-        # state (unknown tenants have no quota to exceed anyway)
+        # state (unknown tenants have no quota to exceed anyway).  The
+        # depth is CLUSTER-wide under a shared ledger — max_waiting is a
+        # per-tenant promise, not a per-replica one.
         cfg = self.tenants.get(t)
         return cfg is not None and cfg.max_waiting is not None and \
-            len(self.queues.get(t, ())) >= cfg.max_waiting
+            self._waiting_total(t) >= cfg.max_waiting
 
     def on_admit(self, req) -> None:
         t = self.tenant_of(req)

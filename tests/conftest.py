@@ -24,6 +24,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite compiles the same tiny-GPT
+# programs hundreds of times across test modules (every engine/trainer
+# fixture re-jits identical HLO). Caching dedupes those both within one
+# pytest run and across runs on the same machine; thresholds are zeroed
+# because the programs are individually small but collectively dominate
+# wall-clock. Tests that count compiles count engine-level traces, not
+# XLA compiles, so cache hits are invisible to assertions.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -43,6 +54,11 @@ def pytest_configure(config):
         "kvcap: KV-capacity matrix (GQA / sliding-window / int4 pages) "
         "parity and accounting tests (tests/test_kv_capacity.py) — "
         "CPU-runnable, included in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated multi-replica serving (router, "
+        "prefill/decode handoff, cluster WFQ, double-buffered dispatch; "
+        "tests/test_disagg.py) — CPU-runnable, included in tier-1")
 
 
 @pytest.fixture(autouse=True)

@@ -573,11 +573,14 @@ _ALLOWED_ROOTS = {"jax", "numpy"}
 #: I/O machinery.  json predates the front end in tracing.py (the Chrome
 #: trace writer).  Keys are import roots, values the allowed basenames.
 _SCOPED_ROOTS = {
-    "asyncio": {"frontend.py"},
+    # r15: the routing tier (router.py) is the only other file allowed
+    # to grow a network surface — today it is in-process and imports
+    # none of these, but the scope records where a transport may live
+    "asyncio": {"frontend.py", "router.py"},
     "http": {"frontend.py"},
-    "socket": {"frontend.py"},
+    "socket": {"frontend.py", "router.py"},
     "socketserver": set(),
-    "selectors": {"frontend.py"},
+    "selectors": {"frontend.py", "router.py"},
     "ssl": set(),
     "json": {"frontend.py", "tracing.py"},
 }
