@@ -1351,14 +1351,17 @@ def _metrics_overhead_bench(hidden=64, layers=2, heads=2, vocab=256,
                             n_requests=16, max_slots=4, page_size=8,
                             prompt_len=12, new_tokens=24, dtype="float32",
                             decode_block=1, seed=0):
-    """Observability must be ~free (r11 acceptance: < 2% goodput cost).
+    """Observability must be ~free (r11 acceptance: < 2% goodput cost;
+    r16 extends the leg: the FULL stack — metrics + trace + flight
+    recorder + SLO layer — must stay within 3%).
 
-    The SAME burst load runs through two freshly-warmed engines — one
-    bare, one feeding a MetricsRegistry AND a TraceRecorder every step —
-    and the ratio of useful tokens/s is the measured cost of observing.
-    The registry work is O(metrics) python per step (dict lookups +
-    float math), invisible next to a jitted device dispatch; this point
-    keeps it that way across future PRs.
+    The SAME burst load runs through freshly-warmed engines — bare,
+    metrics+trace ("on"), and everything ("full": flight ring + a
+    tenant with declared SLO budgets) — and the ratio of useful
+    tokens/s is the measured cost of observing.  The registry work is
+    O(metrics) python per step (dict lookups + float math), invisible
+    next to a jitted device dispatch; this point keeps it that way
+    across future PRs.
     """
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -1379,16 +1382,23 @@ def _metrics_overhead_bench(hidden=64, layers=2, heads=2, vocab=256,
     prompts = rng.randint(0, vocab, (n_requests, prompt_len)).astype("int32")
     useful = n_requests * new_tokens
 
+    from paddle_tpu.serving import TenantConfig
+
+    slo_tenants = {"bench": TenantConfig(ttft_slo_s=30.0, e2e_slo_s=60.0)}
     res = {}
-    for name, observed in (("off", False), ("on", True)):
+    for name, kw in (
+            ("off", {}),
+            ("on", dict(metrics=True, trace=True)),
+            ("full", dict(metrics=True, trace=True, flight=True,
+                          tenants=slo_tenants))):
         eng = ServingEngine(model, max_slots=max_slots, page_size=page_size,
                             greedy=True, decode_block=decode_block,
-                            prefix_cache=False, metrics=observed,
-                            trace=observed)
+                            prefix_cache=False, **kw)
         eng.add_request(prompts[0], 2)    # compile prefill + decode
         eng.run()
+        tenant = "bench" if name == "full" else None
         for p in prompts:
-            eng.add_request(p, new_tokens)
+            eng.add_request(p, new_tokens, tenant=tenant)
         t0 = time.perf_counter()
         eng.run()
         dt = time.perf_counter() - t0
@@ -1396,7 +1406,9 @@ def _metrics_overhead_bench(hidden=64, layers=2, heads=2, vocab=256,
     return {
         "off_tokens_per_sec": res["off"],
         "on_tokens_per_sec": res["on"],
+        "full_tokens_per_sec": res["full"],
         "on_off_ratio": round(res["on"] / max(res["off"], 1e-9), 4),
+        "full_off_ratio": round(res["full"] / max(res["off"], 1e-9), 4),
         "config": {"hidden": hidden, "layers": layers, "heads": heads,
                    "vocab": vocab, "n_requests": n_requests,
                    "max_slots": max_slots, "page_size": page_size,

@@ -50,6 +50,18 @@ CPU-runnable out of the box (tiny config); flags scale it up::
         # r15: dispatch decode step N on device, schedule step N+1 on
         # host, sync one step late — the summary prints the host time
         # still blocked on the device
+    python examples/serve_gpt.py --replicas 2 --disaggregate \\
+            --metrics-dir /tmp/cluster_obs
+        # r16: cluster-wide observability — per-replica metrics_r{i}.prom
+        # plus cluster.prom (one scrape page, TRUE fleet quantiles),
+        # flight_r{i}.json black-box dumps, and ONE merged trace.json
+        # where Perfetto draws the prefill->router->decode handoff as a
+        # flow arrow crossing replica lanes
+    python examples/serve_gpt.py --http 8000 --debug
+        # r16: read-only /debug surface on the front end —
+        # /debug/state (invariant verdicts + stats + flight summaries),
+        # /debug/flight?replica=0 (full decision ring), /debug/trace
+        # (Chrome trace JSON); off by default, 404s when absent
 """
 
 import argparse
@@ -139,14 +151,17 @@ def main():
                     help="overlap host scheduling of step N+1 with the "
                          "device running step N (sync one step late; "
                          "excludes --speculate) (r15)")
+    ap.add_argument("--debug", action="store_true",
+                    help="with --http: expose the read-only /debug "
+                         "surface (state + invariant verdicts, flight-"
+                         "recorder rings, merged Chrome trace) (r16)")
     args = ap.parse_args()
     cluster = args.replicas > 1
-    if cluster and (args.inject_faults is not None
-                    or args.metrics_dir is not None or args.speculate):
+    if cluster and (args.inject_faults is not None or args.speculate):
         ap.error("--replicas > 1 demos routing/handoff; run "
-                 "--inject-faults / --metrics-dir / --speculate on the "
-                 "single-engine demo (chaos + exporters per replica are "
-                 "exercised in tests/test_disagg.py)")
+                 "--inject-faults / --speculate on the single-engine "
+                 "demo (chaos per replica is exercised in "
+                 "tests/test_disagg.py)")
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
@@ -199,6 +214,28 @@ def main():
                             metrics=args.metrics_dir is not None,
                             trace=args.metrics_dir is not None)
     replicas = eng.replicas if cluster else [eng]
+    if cluster and args.metrics_dir is not None:
+        # fleet-wide observability (r16): per-replica registries +
+        # shared-clock tracers + flight recorders; artifacts (cluster.prom,
+        # merged trace.json, flight_r{i}.json) land in --metrics-dir at exit
+        eng.attach_metrics()
+        eng.attach_tracers()
+        eng.attach_flight()
+        os.makedirs(args.metrics_dir, exist_ok=True)
+        for i, rep in enumerate(replicas):
+            rep._crash_dump_dir = args.metrics_dir
+            rep._crash_dump_name = f"flight_crash_r{i}.json"
+    if args.debug:
+        # /debug/flight and /debug/trace 404 unless something is attached
+        if cluster:
+            if eng.tracer is None:
+                eng.attach_tracers()
+            eng.attach_flight()
+        else:
+            if eng.tracer is None:
+                eng.attach_tracer()
+            if eng.flight is None:
+                eng.attach_flight()
     if args.http is not None:
         from paddle_tpu.serving.frontend import serve
 
@@ -212,9 +249,13 @@ def main():
               + (f" replicas={[e.role for e in replicas]}"
                  if cluster else ""))
         try:
-            serve(eng, port=args.http)
+            serve(eng, port=args.http, debug=args.debug)
         finally:
-            if args.metrics_dir is not None:
+            if args.metrics_dir is not None and cluster:
+                eng._dump_artifacts(args.metrics_dir)
+                print(f"cluster artifacts (metrics_r*.prom, cluster.prom, "
+                      f"trace.json, flight_r*.json) -> {args.metrics_dir}")
+            elif args.metrics_dir is not None:
                 # the demo-load exporter path below never runs in HTTP
                 # mode — dump the artifacts the flag promised at exit
                 from paddle_tpu.serving import MetricsFileExporter
@@ -228,7 +269,7 @@ def main():
                 print(f"metrics -> {ex.prom_path}, trace -> {trace}")
         return
     exporter = None
-    if args.metrics_dir is not None:
+    if args.metrics_dir is not None and not cluster:
         from paddle_tpu.serving import MetricsFileExporter, attach_profiler
 
         os.makedirs(args.metrics_dir, exist_ok=True)
@@ -342,6 +383,17 @@ def main():
         print(f"  Prometheus text dump -> {exporter.prom_path}")
         print(f"  request/phase timeline -> {trace_path} "
               f"(open at https://ui.perfetto.dev)")
+    if cluster and args.metrics_dir is not None:
+        eng._dump_artifacts(args.metrics_dir)
+        sc = eng.scalars()
+        print(f"observability: CLUSTER TTFT p50/p99 "
+              f"{sc['serving_ttft_s_p50'] * 1e3:.1f}/"
+              f"{sc['serving_ttft_s_p99'] * 1e3:.1f}ms — true fleet "
+              f"quantiles (histogram buckets merged across replicas)")
+        print(f"  artifacts -> {args.metrics_dir}: metrics_r*.prom, "
+              f"cluster.prom (one scrape page), flight_r*.json black "
+              f"boxes, MERGED trace.json (open at https://ui.perfetto.dev "
+              f"to see handoff arrows cross replica lanes)")
     eng.check_invariants()
 
 

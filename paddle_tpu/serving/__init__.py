@@ -56,6 +56,19 @@ Composes four pieces:
     (:class:`~paddle_tpu.serving.tenancy.ClusterWFQState`), and
     ``double_buffer=True`` overlaps host scheduling of step N+1 with
     the device's step N (``make_cluster`` builds the whole fleet);
+  * cluster-wide observability (r16): replica-namespaced tracing with
+    Chrome flow events stitching prefill export → router pump → decode
+    ingest into ONE merged Perfetto timeline
+    (:func:`~paddle_tpu.serving.tracing.merge_traces` /
+    :func:`~paddle_tpu.serving.tracing.validate_trace`), a bounded
+    per-step :class:`~paddle_tpu.serving.flight_recorder.FlightRecorder`
+    black box on the engine clock (chaos replays dump bit-identically;
+    crashes dump before re-raising), per-tenant SLO attainment + fast /
+    slow burn-rate gauges (:class:`~paddle_tpu.serving.metrics.
+    SLOTracker`, targets on :class:`~paddle_tpu.serving.tenancy.
+    TenantConfig`), histogram-merging cluster aggregation
+    (:func:`~paddle_tpu.serving.metrics.merge_registries`), and the
+    front end's read-only ``/debug`` surface;
   * fault tolerance (r10): on-demand page growth with
     preempt-and-recompute under pool pressure, per-request deadlines /
     ``cancel`` / bounded-queue backpressure,
@@ -74,11 +87,13 @@ from .scheduler import Admission, FCFSScheduler, Request
 from .tenancy import (DEFAULT_TENANT, ClusterWFQState, FCFSPolicy,
                       SchedulerPolicy, TenantConfig, WFQPolicy)
 from .metrics import (Counter, Gauge, Histogram, MetricsFileExporter,
-                      MetricsRegistry, aggregate_scalars,
-                      cluster_prometheus)
-from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, TraceRecorder,
-                      attach_profiler, detach_profiler)
+                      MetricsRegistry, SLOTracker, aggregate_scalars,
+                      cluster_prometheus, merge_registries)
+from .tracing import (PID_ENGINE, PID_HOST, PID_REQUESTS, PID_ROUTER,
+                      TraceRecorder, attach_profiler, detach_profiler,
+                      flow_id, merge_traces, validate_trace)
 from .drafter import NGramDrafter
+from .flight_recorder import FlightRecorder
 from .engine import TERMINAL_REASONS, FinishedRequest, ServingEngine
 from .faults import FaultPlan, InjectedFault
 from .snapshot import handoff_state, restore_engine, snapshot_engine
@@ -91,8 +106,10 @@ __all__ = ["KVPool", "PrefixIndex", "FCFSScheduler", "Request", "Admission",
            "restore_engine", "handoff_state", "MetricsRegistry", "Counter",
            "Gauge", "Histogram", "MetricsFileExporter", "TraceRecorder",
            "attach_profiler", "detach_profiler", "PID_ENGINE",
-           "PID_REQUESTS", "PID_HOST",
+           "PID_REQUESTS", "PID_HOST", "PID_ROUTER",
            "SchedulerPolicy", "FCFSPolicy", "WFQPolicy", "TenantConfig",
            "ClusterWFQState", "DEFAULT_TENANT", "ServingFrontend",
            "NGramDrafter", "Router", "make_cluster",
-           "aggregate_scalars", "cluster_prometheus"]
+           "aggregate_scalars", "cluster_prometheus", "merge_registries",
+           "SLOTracker", "FlightRecorder", "flow_id", "merge_traces",
+           "validate_trace"]

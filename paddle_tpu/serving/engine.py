@@ -100,11 +100,12 @@ from ..kernels import paged_attention as pa
 from ..kernels import paged_prefill as pp
 from .drafter import NGramDrafter
 from .faults import FaultPlan, InjectedFault
+from .flight_recorder import FlightRecorder
 from .kv_pool import KVPool
-from .metrics import MetricsRegistry
+from .metrics import MetricsRegistry, SLOTracker
 from .scheduler import FCFSScheduler, Request
 from .tenancy import normalize_tenants
-from .tracing import PID_ENGINE, PID_REQUESTS, TraceRecorder
+from .tracing import PID_ENGINE, PID_REQUESTS, TraceRecorder, flow_id
 
 #: Reasons a request leaves the engine.  "eos"/"length" are successful
 #: completions; the r10 lifecycle adds the degraded terminals.
@@ -265,7 +266,7 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  faults: Optional[FaultPlan] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 metrics=None, trace=None,
+                 metrics=None, trace=None, flight=None,
                  policy=None, tenants=None,
                  on_token: Optional[Callable[[int, int], None]] = None,
                  spec_k: int = 0, spec_ngram: int = 3, drafter=None,
@@ -470,11 +471,31 @@ class ServingEngine:
                       # ordinary decode output, speculation or not)
                       "spec_drafted": 0, "spec_accepted": 0,
                       "spec_rejected": 0}
-        # observability (r11): both default OFF — the hot loop pays
+        # observability (r11/r16): all default OFF — the hot loop pays
         # nothing unless asked to measure itself
         self.metrics: Optional[MetricsRegistry] = None
         self._m = None
         self.tracer: Optional[TraceRecorder] = None
+        self.flight: Optional[FlightRecorder] = None
+        # replica-namespaced trace lanes: module defaults until
+        # attach_tracer assigns a replica identity
+        self._pid_eng = PID_ENGINE
+        self._pid_req = PID_REQUESTS
+        # handoff trace context: monotonic per-export sequence carried on
+        # the wire record so cross-replica flow arrows get unique ids
+        self._span_seq = 0
+        # SLO layer (r16): per-tenant budgets from TenantConfig; the
+        # tracker registers its series lazily in attach_metrics
+        self._tenant_cfg = normalize_tenants(tenants)
+        self._slo: Optional[SLOTracker] = None
+        # engine-clock stamp of the last completed step — the /healthz
+        # staleness probe (a wedged replica stops advancing this)
+        self._last_step_at: Optional[float] = None
+        # run(metrics_dir=) arms the crash dump: a real exception
+        # escaping step() writes the flight buffer here before re-raising
+        # (the Router renames the file per replica)
+        self._crash_dump_dir: Optional[str] = None
+        self._crash_dump_name = "flight_crash.json"
         # identity tests, not truthiness: an EMPTY registry is falsy
         # (len 0) but still a registry the caller wants fed
         if metrics is not None and metrics is not False:
@@ -483,6 +504,9 @@ class ServingEngine:
         if trace is not None and trace is not False:
             self.attach_tracer(
                 trace if isinstance(trace, TraceRecorder) else None)
+        if flight is not None and flight is not False:
+            self.attach_flight(
+                flight if isinstance(flight, FlightRecorder) else None)
         self._decode_fn = self._build_decode()
         self._prefill_fn = self._build_prefill()
         self._cow_fn = self._build_cow()
@@ -744,13 +768,13 @@ class ServingEngine:
             # global queue bound OR the tenant's own max_waiting quota:
             # both are backpressure, both become an explicit terminal
             if self.tracer is not None:
-                self.tracer.begin("queued", PID_REQUESTS, req.rid)
+                self.tracer.begin("queued", self._pid_req, req.rid)
             self.stats["rejected"] += 1
             self._pending.append(self._terminal(req, "rejected"))
             return req.rid
         rid = self.scheduler.add(req)
         if self.tracer is not None:
-            self.tracer.begin("queued", PID_REQUESTS, req.rid,
+            self.tracer.begin("queued", self._pid_req, req.rid,
                               {"prompt_len": req.prompt_len,
                                "max_new": req.max_new_tokens})
         return rid
@@ -932,22 +956,64 @@ class ServingEngine:
                              "host time blocked on the decode device "
                              "sync (double buffering shrinks this)"),
         }
+        # SLO layer (r16): only tenants that DECLARE budgets cost series
+        if any(c.ttft_slo_s is not None or c.e2e_slo_s is not None
+               for c in self._tenant_cfg.values()):
+            self._slo = SLOTracker(self.metrics)
         return self.metrics
 
-    def attach_tracer(self, tracer: Optional[TraceRecorder] = None
-                      ) -> TraceRecorder:
+    def attach_tracer(self, tracer: Optional[TraceRecorder] = None,
+                      replica: Optional[int] = None,
+                      replica_name: Optional[str] = None) -> TraceRecorder:
         """Start recording the request lifecycle + engine phases as
-        Chrome trace events (fresh recorder if None)."""
+        Chrome trace events (fresh recorder if None).  ``replica``
+        namespaces this engine's lanes (pid block + label prefix) so N
+        replicas' recorders merge into one cluster timeline without
+        colliding (:func:`~paddle_tpu.serving.tracing.merge_traces`)."""
         self.tracer = tracer if tracer is not None else TraceRecorder()
-        self.tracer.process_name(PID_ENGINE, "serving engine (step phases)")
-        self.tracer.process_name(PID_REQUESTS, "requests (tid = rid)")
+        if replica is not None and self.tracer.replica is None:
+            self.tracer.set_replica(replica, name=replica_name)
+        self._pid_eng = self.tracer.pid(PID_ENGINE)
+        self._pid_req = self.tracer.pid(PID_REQUESTS)
+        role = "" if self.role == "both" else f" [{self.role}]"
+        self.tracer.process_name(
+            self._pid_eng,
+            self.tracer.lane_label(f"serving engine{role} (step phases)"))
+        self.tracer.process_name(
+            self._pid_req,
+            self.tracer.lane_label("requests (tid = rid)"))
         return self.tracer
+
+    def attach_flight(self, recorder: Optional[FlightRecorder] = None,
+                      capacity: int = 1024) -> FlightRecorder:
+        """Start the flight recorder (fresh ring of ``capacity`` records
+        if None) — every admission / preemption / handoff / alloc
+        failure / recycle / fault / terminal lands in the ring, stamped
+        on the ENGINE clock for chaos-replay determinism."""
+        self.flight = (recorder if recorder is not None
+                       else FlightRecorder(capacity, clock=self._clock))
+        return self.flight
+
+    def dump_debug(self) -> dict:
+        """Debug snapshot for the /debug surface and crash dumps: step
+        counter, invariant verdict (the audit RUNS here — a violated
+        invariant reports, it doesn't raise), stats ledger, and the
+        flight-recorder ring (None when not attached)."""
+        try:
+            self.check_invariants()
+            verdict = "ok"
+        except AssertionError as e:
+            verdict = f"violated: {e}"
+        return {"step": self._step_idx, "role": self.role,
+                "invariants": verdict, "stats": self.stats_snapshot(),
+                "flight": (self.flight.to_json()
+                           if self.flight is not None else None)}
 
     def _tr_end(self, rid: int, args: Optional[dict] = None) -> None:
         """Close the request's open span, tolerating a tracer attached
         mid-lifecycle (no span open yet)."""
-        if self.tracer.open_span(PID_REQUESTS, rid) is not None:
-            self.tracer.end(PID_REQUESTS, rid, args)
+        if self.tracer.open_span(self._pid_req, rid) is not None:
+            self.tracer.end(self._pid_req, rid, args)
 
     def _tenant_counter(self, family: str, help: str, tenant: str,
                         reason: Optional[str] = None):
@@ -988,10 +1054,15 @@ class ServingEngine:
     def _observe_terminal(self, req: Request, reason: str) -> None:
         """Single funnel for EVERY FinishedRequest creation: terminal
         counters here are exactly one inc per terminal, which is what
-        lets the chaos suite assert registry == observed terminals."""
+        lets the chaos suite assert registry == observed terminals.
+        SLO verdicts (r16) ride the same funnel: every terminal is
+        judged against its tenant's declared budgets exactly once —
+        degraded terminals (reject/expire/cancel) count as misses, so
+        attainment cannot be gamed by shedding load."""
         if self.metrics is not None:
+            now = self._now()
             self._m["terminal"][reason].inc()
-            self._m["e2e"].observe(self._now() - req.t_enqueue)
+            self._m["e2e"].observe(now - req.t_enqueue)
             if req.spec_drafted > 0:
                 self._m["spec_accept_rate"].observe(
                     req.spec_accepted / req.spec_drafted)
@@ -999,9 +1070,27 @@ class ServingEngine:
                 self._tenant_counter("serving_tenant_requests_terminal",
                                      "per-tenant terminals by reason",
                                      req.tenant, reason).inc()
+            if self._slo is not None and req.tenant is not None:
+                cfg = self._tenant_cfg.get(req.tenant)
+                if cfg is not None:
+                    if cfg.ttft_slo_s is not None:
+                        ok = (req.t_first_token is not None
+                              and req.t_first_token - req.t_enqueue
+                              <= cfg.ttft_slo_s)
+                        self._slo.observe(req.tenant, "ttft", ok, now,
+                                          cfg.slo_objective)
+                    if cfg.e2e_slo_s is not None:
+                        ok = (reason in ("eos", "length")
+                              and now - req.t_enqueue <= cfg.e2e_slo_s)
+                        self._slo.observe(req.tenant, "e2e", ok, now,
+                                          cfg.slo_objective)
+        if self.flight is not None:
+            self.flight.record("terminal", self._step_idx, rid=req.rid,
+                               reason=reason, tokens=len(req.generated),
+                               tenant=req.tenant)
         if self.tracer is not None:
             self._tr_end(req.rid)
-            self.tracer.instant(reason, PID_REQUESTS, req.rid,
+            self.tracer.instant(reason, self._pid_req, req.rid,
                                 {"rid": req.rid,
                                  "tokens": len(req.generated)})
 
@@ -1073,12 +1162,18 @@ class ServingEngine:
         st.request.n_preempted += 1
         self.scheduler.requeue(st.request)
         self.stats["preemptions"] += 1
+        if self.flight is not None:
+            self.flight.record("preempt", self._step_idx,
+                               victim=st.request.rid, slot=idx,
+                               reason="page_pressure",
+                               generated=len(st.request.generated),
+                               pages_freed=len(st.pages))
         if self.tracer is not None:
             rid = st.request.rid
             self._tr_end(rid)            # the "resident" span
-            self.tracer.instant("preempt", PID_REQUESTS, rid,
+            self.tracer.instant("preempt", self._pid_req, rid,
                                 {"generated": len(st.request.generated)})
-            self.tracer.begin("queued", PID_REQUESTS, rid,
+            self.tracer.begin("queued", self._pid_req, rid,
                               {"recompute": True})
 
     def _pick_victim(self) -> Optional[int]:
@@ -1152,12 +1247,17 @@ class ServingEngine:
                 self._m["cow"].inc()
         if req.t_admitted is None:
             req.t_admitted = now
+        if self.flight is not None:
+            self.flight.record("admit", self._step_idx, rid=req.rid,
+                               slot=idx, matched=adm.matched,
+                               recompute=req.n_preempted > 0,
+                               tenant=req.tenant)
         if self.tracer is not None:
             self._tr_end(req.rid)             # the "queued" span
             if adm.cow is not None:
-                self.tracer.instant("cow_clone", PID_REQUESTS, req.rid,
+                self.tracer.instant("cow_clone", self._pid_req, req.rid,
                                     {"matched_tokens": adm.cow[1]})
-            self.tracer.begin("resident", PID_REQUESTS, req.rid,
+            self.tracer.begin("resident", self._pid_req, req.rid,
                               {"slot": idx, "matched": adm.matched,
                                "preempted": req.n_preempted})
 
@@ -1187,7 +1287,7 @@ class ServingEngine:
                 toks = np.zeros((c_pad,), np.int32)
                 toks[:n] = work[st.prefilled:st.prefilled + n]
                 if self.tracer is not None:
-                    self.tracer.begin("prefill_chunk", PID_REQUESTS,
+                    self.tracer.begin("prefill_chunk", self._pid_req,
                                       req.rid, {"start": st.prefilled,
                                                 "n": n})
                 t_c = time.perf_counter()
@@ -1199,7 +1299,7 @@ class ServingEngine:
                 if self.metrics is not None:
                     self._m["chunk_s"].observe(time.perf_counter() - t_c)
                 if self.tracer is not None:
-                    self.tracer.end(PID_REQUESTS, req.rid)
+                    self.tracer.end(self._pid_req, req.rid)
                 self.stats["prefill_calls"] += 1
                 st.prefilled += n
                 budget -= n
@@ -1235,7 +1335,7 @@ class ServingEngine:
                     if self.metrics is not None:
                         self._m["ttft"].observe(now - req.t_enqueue)
                     if self.tracer is not None:
-                        self.tracer.instant("first_token", PID_REQUESTS,
+                        self.tracer.instant("first_token", self._pid_req,
                                             req.rid)
                     req.t_first_token = now
                 elif self.metrics is not None and req.t_last_token is not None:
@@ -1276,6 +1376,11 @@ class ServingEngine:
                 st.pages.extend(got)
                 st.hw_pages += len(got)
                 return True
+            if self.flight is not None:
+                self.flight.record(
+                    "alloc_fail", self._step_idx, rid=st.request.rid,
+                    need=need, free=self.pool.num_free,
+                    reclaimable=self.pool.num_reclaimable)
             if self.pool.num_free + self.pool.num_reclaimable >= need:
                 # the pool COULD satisfy the lease, so the failure is a
                 # transient allocator fault (fault injection), not real
@@ -1313,6 +1418,9 @@ class ServingEngine:
         del st.pages[:dead - done]
         self._table[idx, done:dead] = 0
         self.pool.free(victims)
+        if self.flight is not None:
+            self.flight.record("window_recycle", self._step_idx,
+                               rid=st.request.rid, pages=len(victims))
 
     # -- disaggregated prefill/decode handoff (r15) -----------------------
 
@@ -1357,10 +1465,23 @@ class ServingEngine:
                 self.stats["handoff_faults"] += 1
             else:
                 self.stats["handoff_bytes"] += h["nbytes"]
+            if self.flight is not None:
+                self.flight.record("handoff_out", self._step_idx,
+                                   rid=st.request.rid,
+                                   nbytes=h["nbytes"],
+                                   n_pages=h["n_pages"],
+                                   degraded=degraded)
             if self.tracer is not None:
                 rid = st.request.rid
+                tr = h.get("trace")
+                if tr is not None:
+                    # INSIDE the resident span (before _tr_end closes
+                    # it): the flow arrow leaves from the prefill slice
+                    self.tracer.flow_start(
+                        "handoff", self._pid_req, rid,
+                        flow_id(tr["rid"], tr["seq"]))
                 self._tr_end(rid)            # the "resident" span
-                self.tracer.instant("handoff", PID_REQUESTS, rid,
+                self.tracer.instant("handoff", self._pid_req, rid,
                                     {"n_pages": h["n_pages"],
                                      "nbytes": h["nbytes"],
                                      "degraded": degraded})
@@ -1399,6 +1520,11 @@ class ServingEngine:
             if v is not None:
                 setattr(req, attr, v + delta)
         self.stats["handoffs_in"] += 1
+        if self.flight is not None:
+            self.flight.record("handoff_in", self._step_idx, rid=req.rid,
+                               nbytes=int(h["nbytes"]),
+                               n_pages=int(h["n_pages"]),
+                               degraded=payload is None)
         if payload is None:
             # degraded transfer: the request was already accepted and
             # billed, so it bypasses backpressure and requeues at the
@@ -1409,7 +1535,7 @@ class ServingEngine:
             req.n_preempted += 1
             self.scheduler.requeue(req)
             if self.tracer is not None:
-                self.tracer.begin("queued", PID_REQUESTS, req.rid,
+                self.tracer.begin("queued", self._pid_req, req.rid,
                                   {"recompute": True, "handoff": True})
         else:
             self._handoff_in.append(dict(
@@ -1417,8 +1543,17 @@ class ServingEngine:
                 n_pages=int(h["n_pages"]), payload=payload,
                 nbytes=int(h["nbytes"])))
             if self.tracer is not None:
-                self.tracer.begin("queued", PID_REQUESTS, req.rid,
+                self.tracer.begin("queued", self._pid_req, req.rid,
                                   {"handoff": True})
+        if self.tracer is not None:
+            tr = h.get("trace")
+            if tr is not None:
+                # inside the just-opened "queued" span (bp="e" binds the
+                # arrow head to the enclosing slice): the flow lands on
+                # the decode replica's lane
+                self.tracer.flow_finish("handoff", self._pid_req,
+                                        req.rid,
+                                        flow_id(tr["rid"], tr["seq"]))
         return req.rid
 
     def _admit_handoffs(self, finished: List[FinishedRequest]) -> None:
@@ -1473,9 +1608,14 @@ class ServingEngine:
                 work = req.work_prompt()[:base_len]
                 nfull = base_len // self.page_size
                 self.pool.prefix.insert(work, st.pages[:nfull])
+        if self.flight is not None:
+            self.flight.record("admit", self._step_idx, rid=req.rid,
+                               slot=slot, handoff=True,
+                               adopted_pages=len(pages),
+                               tenant=req.tenant)
         if self.tracer is not None:
             self._tr_end(req.rid)            # the "queued" span
-            self.tracer.begin("resident", PID_REQUESTS, req.rid,
+            self.tracer.begin("resident", self._pid_req, req.rid,
                               {"slot": slot, "handoff": True,
                                "adopted_pages": len(pages)})
         return True
@@ -1502,16 +1642,33 @@ class ServingEngine:
         phase = self._phase_s = {}
         try:
             self._run_step(finished)
-        except InjectedFault:
+        except InjectedFault as e:
             self.stats["step_faults"] += 1
-        except BaseException:
+            if self.flight is not None:
+                self.flight.record("injected_fault", self._step_idx,
+                                   error=str(e))
+        except BaseException as e:
             # a REAL fault escaping mid-step must not swallow terminals
             # already recorded this iteration (their pages are freed) —
             # re-park them so a retrying host loop still delivers every
             # request exactly one terminal state
             self._pending = finished + self._pending
+            # black box first (r16): before the exception unwinds the
+            # host loop, the flight ring lands next to the metrics
+            # artifacts — the postmortem starts with the last N
+            # decisions, not just a stack trace
+            if self.flight is not None:
+                self.flight.record("crash", self._step_idx,
+                                   error=f"{type(e).__name__}: {e}")
+                if self._crash_dump_dir is not None:
+                    try:
+                        self.flight.dump(os.path.join(
+                            self._crash_dump_dir, self._crash_dump_name))
+                    except OSError:
+                        pass          # the dump must never mask the fault
             raise
         dt = time.perf_counter() - t0
+        self._last_step_at = self._now()
         self.stats["pages_in_use"] = self.pool.pages_in_use
         self.stats["queue_depth"] = self.scheduler.n_waiting
         self.stats["step_wall_s"] += dt
@@ -1523,7 +1680,7 @@ class ServingEngine:
             self.stats[f"last_{ph}_s"] = v
         if self.tracer is not None:
             for ph, (start, dur) in phase.items():
-                self.tracer.complete(ph, start, dur, PID_ENGINE, 0,
+                self.tracer.complete(ph, start, dur, self._pid_eng, 0,
                                      {"step": self._step_idx})
         if self.metrics is not None:
             self._sync_metrics(dt, phase)
@@ -1574,6 +1731,10 @@ class ServingEngine:
         for ph in ("admit", "prefill", "handoff", "decode"):
             if ph in phase:
                 m[f"{ph}_s"].observe(phase[ph][1])
+        if self._slo is not None:
+            # per step, not per terminal: burn-rate windows must page
+            # OUT (and the gauges decay) even when nothing terminates
+            self._slo.sync(self._now())
 
     def _run_step(self, finished: List[FinishedRequest]) -> None:
         phase = self._phase_s
@@ -1927,7 +2088,12 @@ class ServingEngine:
                 self.attach_metrics()
             if self.tracer is None:
                 self.attach_tracer()
+            if self.flight is None:
+                self.attach_flight()
             os.makedirs(metrics_dir, exist_ok=True)
+            # arm the crash dump: a real exception escaping step()
+            # writes flight_crash.json here before re-raising
+            self._crash_dump_dir = metrics_dir
             exporter = MetricsFileExporter(self.metrics, metrics_dir)
         done: Dict[int, FinishedRequest] = {}
         try:
@@ -1947,6 +2113,9 @@ class ServingEngine:
                 if self.tracer is not None:
                     self.tracer.save(
                         os.path.join(metrics_dir, "trace.json"))
+                if self.flight is not None:
+                    self.flight.dump(
+                        os.path.join(metrics_dir, "flight.json"))
         # teardown: with every request terminal the pool must be back at
         # the cached-prefix-only baseline — any page still referenced by
         # a live slot (there are none) is a leak

@@ -23,7 +23,12 @@ Endpoints:
     ``stream: false`` (single JSON response).
   * ``GET /metrics`` — the r11 registry's Prometheus text exposition
     (per-tenant labeled series included), scrapeable in place.
-  * ``GET /healthz`` — liveness + queue/slot/pool gauges as JSON.
+  * ``GET /healthz`` — liveness + queue/slot/pool gauges as JSON, plus
+    per-replica ``last_step_age_s`` staleness (r16).
+  * ``GET /debug/{state,flight,trace}`` (r16, ``debug=True`` only) —
+    read-only introspection: ledgers + invariant verdicts, one
+    replica's flight-recorder ring (``?replica=N``), and the (merged,
+    for a cluster) Chrome trace.
 
 SLO semantics at the HTTP layer:
 
@@ -84,8 +89,13 @@ class ServingFrontend:
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 idle_sleep_s: float = 0.002, max_tenants: int = 256):
+                 idle_sleep_s: float = 0.002, max_tenants: int = 256,
+                 debug: bool = False):
         self.engine = engine
+        # the read-only /debug surface (state, flight ring, trace) is
+        # OFF by default: it exposes internals and full rings — opt in
+        # per deployment (examples/serve_gpt.py --debug)
+        self.debug = debug
         # a Router drives like an engine; only observability and the
         # backpressure probe need to know there is a fleet behind it
         self._is_cluster = hasattr(engine, "replicas")
@@ -293,6 +303,13 @@ class ServingFrontend:
                                         for r in reps),
                     "pages_free": sum(r.pool.num_free for r in reps),
                     "policy": reps[0].scheduler.policy.name,
+                    # staleness per replica: seconds (engine clock)
+                    # since its last completed step — a wedged replica
+                    # shows a growing age while the fleet looks alive
+                    "last_step_age_s": [
+                        (r._now() - r._last_step_at)
+                        if r._last_step_at is not None else None
+                        for r in reps],
                 }).encode()
             else:
                 payload = json.dumps({
@@ -305,6 +322,9 @@ class ServingFrontend:
                     "pages_in_use": eng.pool.pages_in_use,
                     "pages_free": eng.pool.num_free,
                     "policy": eng.scheduler.policy.name,
+                    "last_step_age_s": (
+                        (eng._now() - eng._last_step_at)
+                        if eng._last_step_at is not None else None),
                 }).encode()
             await self._send(writer, "/healthz", 503 if dead else 200,
                              payload)
@@ -319,11 +339,88 @@ class ServingFrontend:
                 text = self.engine.metrics.to_prometheus().encode()
             await self._send(writer, "/metrics", 200, text,
                              ctype="text/plain; version=0.0.4")
+        elif method == "GET" and \
+                path.partition("?")[0].startswith("/debug/"):
+            await self._debug(path, writer)
         elif method == "POST" and path == "/v1/completions":
             await self._completions(body, reader, writer)
         else:
             # FIXED label, not the client-supplied path: arbitrary paths
             # must not mint unbounded counter series in the registry
+            await self._send(writer, "unknown", 404,
+                             b'{"error": "not found"}')
+
+    # -- /debug -----------------------------------------------------------
+
+    @staticmethod
+    def _flight_summary(dump: dict) -> dict:
+        """Strip a dump_debug payload's flight ring to its counters —
+        /debug/state stays light; the full ring is /debug/flight."""
+        fl = dump.get("flight")
+        if fl is not None:
+            dump["flight"] = {k: fl[k]
+                              for k in ("capacity", "recorded", "dropped")}
+        return dump
+
+    async def _debug(self, path: str, writer) -> None:
+        """Read-only introspection (``debug=True`` only — 404 when off,
+        indistinguishable from absent): ``/debug/state`` (ledgers +
+        invariant verdicts), ``/debug/flight?replica=N`` (one black-box
+        ring, full), ``/debug/trace`` (Chrome trace JSON — merged
+        across the fleet for a cluster)."""
+        base, _, query = path.partition("?")
+        eng = self.engine
+        if not self.debug:
+            await self._send(writer, "unknown", 404,
+                             b'{"error": "not found"}')
+            return
+        if base == "/debug/state":
+            if self._is_cluster:
+                payload = eng.dump_debug()
+                payload["replicas"] = [self._flight_summary(d)
+                                       for d in payload["replicas"]]
+            else:
+                payload = self._flight_summary(eng.dump_debug())
+            await self._send(writer, "/debug/state", 200,
+                             json.dumps(payload, default=float).encode())
+        elif base == "/debug/flight":
+            replica = 0
+            for part in query.split("&"):
+                if part.startswith("replica="):
+                    try:
+                        replica = int(part[len("replica="):])
+                    except ValueError:
+                        await self._send(
+                            writer, "/debug/flight", 400,
+                            b'{"error": "replica must be an integer"}')
+                        return
+            engines = eng.replicas if self._is_cluster else [eng]
+            if not 0 <= replica < len(engines):
+                await self._send(
+                    writer, "/debug/flight", 400, json.dumps(
+                        {"error": f"replica must be in "
+                                  f"0..{len(engines) - 1}"}).encode())
+                return
+            fl = engines[replica].flight
+            if fl is None:
+                await self._send(
+                    writer, "/debug/flight", 404,
+                    b'{"error": "flight recorder not attached"}')
+                return
+            await self._send(writer, "/debug/flight", 200,
+                             json.dumps(fl.to_json(),
+                                        default=float).encode())
+        elif base == "/debug/trace":
+            tracer = eng.tracer
+            if tracer is None:
+                await self._send(writer, "/debug/trace", 404,
+                                 b'{"error": "tracer not attached"}')
+                return
+            trace = (eng.merged_trace() if self._is_cluster
+                     else tracer.to_json())
+            await self._send(writer, "/debug/trace", 200,
+                             json.dumps(trace).encode())
+        else:
             await self._send(writer, "unknown", 404,
                              b'{"error": "not found"}')
 
@@ -552,11 +649,12 @@ class ServingFrontend:
 
 
 def serve(engine, host: str = "127.0.0.1", port: int = 8000,
-          banner: bool = True) -> None:
+          banner: bool = True, debug: bool = False) -> None:
     """Blocking convenience: run the front end until interrupted
     (examples/serve_gpt.py ``--http``)."""
     async def _main():
-        fe = await ServingFrontend(engine, host, port).start()
+        fe = await ServingFrontend(engine, host, port,
+                                   debug=debug).start()
         if banner:
             print(f"serving on http://{fe.host}:{fe.port}  "
                   f"(POST /v1/completions, GET /metrics, GET /healthz)")

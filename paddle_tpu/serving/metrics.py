@@ -54,7 +54,8 @@ from bisect import bisect_left
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "MetricsFileExporter"]
+           "MetricsFileExporter", "SLOTracker", "merge_registries",
+           "cluster_prometheus", "aggregate_scalars"]
 
 
 def _sanitize(name: str) -> str:
@@ -252,6 +253,25 @@ class Histogram:
         self.sum = float(st["sum"])
         self.min = st["min"]
         self.max = st["max"]
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram's buckets into this one element-wise —
+        the cluster-quantile primitive: summed bucket counts over N
+        replicas give the SAME quantile estimate a single histogram fed
+        the union of samples would (identical bounds required; engines
+        built from one config always share them)."""
+        if self.bounds != other.bounds:
+            raise ValueError(
+                f"cannot merge {self.name!r}: bucket bounds differ")
+        self.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None:
+            self.min = (other.min if self.min is None
+                        else min(self.min, other.min))
+        if other.max is not None:
+            self.max = (other.max if self.max is None
+                        else max(self.max, other.max))
 
 
 class MetricsRegistry:
@@ -471,38 +491,174 @@ def cluster_prometheus(parts: Dict[str, "MetricsRegistry"]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def merge_registries(parts: Dict[str, "MetricsRegistry"]
+                     ) -> MetricsRegistry:
+    """Fold per-replica registries into ONE merged registry: counters
+    and gauges sum values, histograms sum bucket counts element-wise
+    (:meth:`Histogram.merge`) and combine exact min/max — so quantiles
+    read off the merged registry are REAL cluster quantiles, identical
+    to a single registry fed the union of samples (up to the shared
+    bucket resolution; asserted against that oracle in
+    tests/test_observability.py).  Replica keys iterate sorted, so the
+    result is deterministic.  The merged registry is a read-only
+    rollup — don't attach an engine to it."""
+    kinds = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+    merged = MetricsRegistry()
+    for rep in sorted(parts):
+        for key, m in parts[rep]._metrics.items():
+            cur = merged._metrics.get(key)
+            if cur is None:
+                st = m.to_state()
+                mm = kinds[st["kind"]](m.name, help=m.help,
+                                       labels=m.labels or None)
+                mm.load_state(st)
+                merged._metrics[key] = mm
+                merged._family_kind.setdefault(m.name, m.kind)
+            elif cur.kind != m.kind:
+                raise ValueError(
+                    f"metric {m.name!r} is {cur.kind} on one replica "
+                    f"and {m.kind} on another")
+            elif isinstance(cur, Histogram):
+                cur.merge(m)
+            else:
+                cur.value += m.value
+    return merged
+
+
 def aggregate_scalars(parts: Dict[str, "MetricsRegistry"]
                       ) -> Dict[str, float]:
-    """Cluster rollup of per-replica ``scalars()``: counters, gauges and
-    histogram ``_count``/``_sum`` tags SUM across replicas; ``_min`` /
-    ``_max`` combine by min/max; ``_mean`` recomputes from the summed
-    totals.  Per-replica quantiles (``_p50``/``_p90``/``_p99``) are
-    DROPPED — order statistics don't aggregate, and a made-up "cluster
-    p99" would be worse than none.  Ratio gauges (hit rate, budget
-    utilization) sum like any gauge: divide by the replica count, or
-    read the per-replica registries, when you want the level."""
-    out: Dict[str, float] = {}
-    mins: Dict[str, float] = {}
-    maxs: Dict[str, float] = {}
-    for reg in parts.values():
-        for tag, v in reg.scalars().items():
-            stem = tag.split(".", 1)[0]
-            if stem.endswith(("_p50", "_p90", "_p99", "_mean")):
-                continue
-            if stem.endswith("_min"):
-                mins[tag] = v if tag not in mins else min(mins[tag], v)
-            elif stem.endswith("_max"):
-                maxs[tag] = v if tag not in maxs else max(maxs[tag], v)
-            else:
-                out[tag] = out.get(tag, 0.0) + v
-    out.update(mins)
-    out.update(maxs)
-    for tag in list(out):
-        stem, dot, lbl = tag.partition(".")
-        if stem.endswith("_count") and out[tag]:
-            base = stem[:-len("_count")]
-            sfx = (dot + lbl) if dot else ""
-            sum_tag = base + "_sum" + sfx
-            if sum_tag in out:
-                out[base + "_mean" + sfx] = out[sum_tag] / out[tag]
-    return out
+    """Cluster rollup of per-replica registries as one scalar table:
+    counters and gauges SUM across replicas; histogram buckets merge
+    element-wise so ``_p50``/``_p90``/``_p99`` are REAL cluster
+    quantiles (pre-r16 this dropped quantiles outright), ``_min`` /
+    ``_max`` combine exactly, and ``_mean`` is the merged sum/count.
+    Ratio gauges (hit rate, budget utilization) still sum like any
+    gauge: divide by the replica count, or read the per-replica
+    registries, when you want the level."""
+    return merge_registries(parts).scalars()
+
+
+# -- SLO attainment + burn rate (r16) ----------------------------------------
+
+
+class _RollingWindow:
+    """Bucketed rolling good/bad tally on an injectable clock.
+
+    ``n_buckets`` fixed slots of ``window_s / n_buckets`` seconds each,
+    recycled by epoch number — O(1) observe, O(n_buckets) readout, no
+    timestamps stored, fully deterministic under the chaos virtual
+    clock.  A slot whose epoch fell out of the window reads as empty
+    (and is zeroed on reuse), so the tally always covers the trailing
+    ``window_s`` seconds to bucket resolution."""
+
+    __slots__ = ("window_s", "n_buckets", "bucket_s", "good", "bad",
+                 "epoch")
+
+    def __init__(self, window_s: float, n_buckets: int = 30):
+        self.window_s = float(window_s)
+        self.n_buckets = int(n_buckets)
+        self.bucket_s = self.window_s / self.n_buckets
+        self.good = [0] * self.n_buckets
+        self.bad = [0] * self.n_buckets
+        self.epoch: List[Optional[int]] = [None] * self.n_buckets
+
+    def _slot(self, now: float) -> int:
+        e = int(now // self.bucket_s)
+        i = e % self.n_buckets
+        if self.epoch[i] != e:
+            self.epoch[i] = e
+            self.good[i] = 0
+            self.bad[i] = 0
+        return i
+
+    def observe(self, now: float, ok: bool) -> None:
+        i = self._slot(now)
+        if ok:
+            self.good[i] += 1
+        else:
+            self.bad[i] += 1
+
+    def bad_fraction(self, now: float) -> float:
+        e_now = int(now // self.bucket_s)
+        good = bad = 0
+        for i in range(self.n_buckets):
+            e = self.epoch[i]
+            if e is not None and 0 <= e_now - e < self.n_buckets:
+                good += self.good[i]
+                bad += self.bad[i]
+        total = good + bad
+        return bad / total if total else 0.0
+
+
+class SLOTracker:
+    """Per-tenant SLO attainment + multi-window burn rate.
+
+    The SRE error-budget idiom: for each (tenant, slo-kind) pair track
+    lifetime attainment (``serving_slo_attainment{tenant=,slo=}``, the
+    fraction of requests inside budget) and TWO rolling windows —
+    ``fast`` (1-min-equivalent, pages quickly) and ``slow``
+    (1-hr-equivalent, resists flapping) — whose **burn rate** is the
+    window's bad fraction divided by the error budget
+    ``1 - objective``; burn > 1 means the budget is being spent faster
+    than the objective allows.  All series register lazily in the
+    engine's registry, so tenants without SLOs cost nothing; all time
+    comes from the engine clock, so chaos replays are deterministic.
+    """
+
+    FAST_WINDOW_S = 60.0
+    SLOW_WINDOW_S = 3600.0
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self._series: Dict[tuple, dict] = {}
+
+    def _get(self, tenant: str, kind: str, objective: float) -> dict:
+        key = (tenant, kind)
+        s = self._series.get(key)
+        if s is None:
+            lb = {"tenant": tenant, "slo": kind}
+            reg = self.registry
+            s = {
+                "total": reg.counter(
+                    "serving_slo_total",
+                    "requests evaluated against this SLO", labels=lb),
+                "miss": reg.counter(
+                    "serving_slo_miss",
+                    "requests that missed their SLO budget", labels=lb),
+                "attain": reg.gauge(
+                    "serving_slo_attainment",
+                    "lifetime fraction of requests inside the SLO "
+                    "budget", labels=lb),
+                "burn_fast": reg.gauge(
+                    "serving_slo_burn_rate",
+                    "windowed bad-fraction / error budget; > 1 burns "
+                    "the budget faster than the objective allows",
+                    labels={**lb, "window": "fast"}),
+                "burn_slow": reg.gauge(
+                    "serving_slo_burn_rate", "",
+                    labels={**lb, "window": "slow"}),
+                "fast": _RollingWindow(self.FAST_WINDOW_S),
+                "slow": _RollingWindow(self.SLOW_WINDOW_S),
+                "objective": float(objective),
+            }
+            self._series[key] = s
+        return s
+
+    def observe(self, tenant: str, kind: str, ok: bool, now: float,
+                objective: float) -> None:
+        """Record one terminal's verdict against one SLO kind."""
+        s = self._get(tenant, kind, objective)
+        s["total"].inc()
+        if not ok:
+            s["miss"].inc()
+        s["attain"].set(1.0 - s["miss"].value / s["total"].value)
+        s["fast"].observe(now, ok)
+        s["slow"].observe(now, ok)
+
+    def sync(self, now: float) -> None:
+        """Refresh the burn-rate gauges at ``now`` (called per step —
+        windows page OUT even when no new terminals arrive)."""
+        for s in self._series.values():
+            budget = max(1.0 - s["objective"], 1e-9)
+            s["burn_fast"].set(s["fast"].bad_fraction(now) / budget)
+            s["burn_slow"].set(s["slow"].bad_fraction(now) / budget)

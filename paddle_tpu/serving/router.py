@@ -38,6 +38,8 @@ to the front tier; this module is plain host code over numpy records).
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -45,6 +47,7 @@ import numpy as np
 from .engine import FinishedRequest, ServingEngine
 from .scheduler import Request
 from .tenancy import ClusterWFQState, WFQPolicy
+from .tracing import (PID_ROUTER, TraceRecorder, flow_id, merge_traces)
 
 __all__ = ["Router", "make_cluster"]
 
@@ -94,6 +97,11 @@ class Router:
             "degraded_handoffs": 0,    # records pumped WITHOUT payload
         }
         self._parts: Optional[Dict[str, object]] = None
+        # cluster tracing (attach_tracers): the router's own recorder
+        # plus one per replica, all on ONE shared clock so merge_traces
+        # can rebase them onto a single timeline
+        self.tracer: Optional[TraceRecorder] = None
+        self._tracers: List[TraceRecorder] = []
 
     # -- streaming --------------------------------------------------------
 
@@ -149,11 +157,23 @@ class Router:
                 tokens=np.asarray(req.generated, np.int32),
                 finish_reason="rejected", n_steps=0))
             return req.rid
+        t_pick = self.tracer._clock() if self.tracer is not None else 0.0
         i, matched = self._pick_replica(req)
         self.stats["routed"][i] += 1
         if matched:
             self.stats["prefix_routed"] += 1
             self.stats["prefix_match_tokens"] += matched
+        if self.tracer is not None:
+            # the routing decision as an X span on the router lane:
+            # WHY this replica won (cache affinity vs. load) is visible
+            # right next to the request's lifecycle in the merged trace
+            self.tracer.complete(
+                "route", t_pick, self.tracer._clock() - t_pick,
+                PID_ROUTER, 0,
+                args={"rid": int(req.rid), "replica": int(i),
+                      "prefix_match_len": int(matched),
+                      "load_score": float(
+                          self.prefill_targets[i].load_score())})
         return self.prefill_targets[i]._enqueue(req)
 
     def _pick_replica(self, req: Request):
@@ -218,23 +238,65 @@ class Router:
                     self.stats["degraded_handoffs"] += 1
                 else:
                     self.stats["handoff_bytes"] += h["nbytes"]
+                tr = h.get("trace")
+                if self.tracer is not None:
+                    self.tracer.begin(
+                        "pump_handoff", PID_ROUTER, 0,
+                        args={"rid": int(h["request"]["rid"]),
+                              "to_replica": int(j),
+                              "nbytes": int(h["nbytes"]),
+                              "degraded": h["payload"] is None})
+                    if tr is not None:
+                        # the "t" hop of the handoff arrow: binds to
+                        # this pump span, between the prefill export
+                        # ("s") and the decode ingest ("f")
+                        self.tracer.flow_step(
+                            "handoff", PID_ROUTER, 0,
+                            flow_id(tr["rid"], tr["seq"]))
                 self.decode_targets[j].ingest_handoff(h)
+                if self.tracer is not None:
+                    self.tracer.end(PID_ROUTER, 0)
 
-    def run(self, requests: Optional[Sequence] = None
+    def run(self, requests: Optional[Sequence] = None,
+            metrics_dir: Optional[str] = None
             ) -> Dict[int, FinishedRequest]:
         """Drive the cluster to drain; returns {rid: FinishedRequest}
         with degraded terminals included — the fleet-level mirror of
-        ``ServingEngine.run``.  Asserts every replica drained leak-free."""
+        ``ServingEngine.run``.  Asserts every replica drained leak-free.
+
+        ``metrics_dir`` turns the drain into an observed run
+        (auto-attaching metrics, shared-clock tracers and flight
+        recorders if none are set); at drain the dir holds
+        ``metrics_r{i}.prom`` per replica, ``cluster.prom`` (one scrape
+        page for the fleet), ``trace.json`` (the MERGED cluster trace —
+        open in Perfetto to see handoff arrows cross replicas) and
+        ``flight_r{i}.json`` black-box dumps.  A crash escaping any
+        replica's step loop dumps ``flight_crash_r{i}.json`` before
+        re-raising."""
         for r in requests or ():
             if isinstance(r, Request):
                 self.submit(r)
             else:
                 prompt, max_new = r
                 self.add_request(prompt, max_new)
+        if metrics_dir is not None:
+            if self._parts is None:
+                self.attach_metrics()
+            if self.tracer is None:
+                self.attach_tracers()
+            self.attach_flight()
+            os.makedirs(metrics_dir, exist_ok=True)
+            for i, eng in enumerate(self.replicas):
+                eng._crash_dump_dir = metrics_dir
+                eng._crash_dump_name = f"flight_crash_r{i}.json"
         done: Dict[int, FinishedRequest] = {}
-        while self.has_work:
-            for fin in self.step():
-                done[fin.rid] = fin
+        try:
+            while self.has_work:
+                for fin in self.step():
+                    done[fin.rid] = fin
+        finally:
+            if metrics_dir is not None:
+                self._dump_artifacts(metrics_dir)
         for i, eng in enumerate(self.replicas):
             if eng.scheduler.n_active or eng.pool.pages_in_use:
                 raise AssertionError(
@@ -243,12 +305,76 @@ class Router:
                     f"{eng.pool.pages_in_use} pages in use")
         return done
 
+    def _dump_artifacts(self, metrics_dir: str) -> None:
+        from .metrics import cluster_prometheus
+        from .tracing import save_trace
+
+        for i, eng in enumerate(self.replicas):
+            if eng.metrics is not None:
+                with open(os.path.join(metrics_dir,
+                                       f"metrics_r{i}.prom"), "w") as f:
+                    f.write(eng.metrics.to_prometheus())
+            if eng.flight is not None:
+                eng.flight.dump(os.path.join(metrics_dir,
+                                             f"flight_r{i}.json"))
+        if self._parts is not None:
+            with open(os.path.join(metrics_dir, "cluster.prom"),
+                      "w") as f:
+                f.write(cluster_prometheus(self._parts))
+        if self.tracer is not None:
+            save_trace(self.merged_trace(),
+                       os.path.join(metrics_dir, "trace.json"))
+
     # -- audits + observability -------------------------------------------
 
     def check_invariants(self) -> None:
         """Every replica's page-leak/refcount/scheduler audit."""
         for eng in self.replicas:
             eng.check_invariants()
+
+    def attach_tracers(self, clock: Optional[Callable[[], float]] = None
+                       ) -> TraceRecorder:
+        """Cluster tracing: one recorder per replica (lanes namespaced
+        ``replica * PID_STRIDE + base``, labels prefixed ``r{i}:``) plus
+        the router's own PID_ROUTER lane, ALL on one shared clock —
+        the precondition for :func:`~paddle_tpu.serving.tracing.
+        merge_traces` rebasing them onto a single timeline.  Returns
+        the router's recorder."""
+        clk = clock or time.perf_counter
+        self.tracer = TraceRecorder(clock=clk)
+        self.tracer.process_name(
+            PID_ROUTER, "router (routing + handoff pump)")
+        self._tracers = []
+        for i, eng in enumerate(self.replicas):
+            rec = TraceRecorder(clock=clk)
+            eng.attach_tracer(rec, replica=i)
+            self._tracers.append(rec)
+        return self.tracer
+
+    def attach_flight(self, capacity: int = 1024) -> None:
+        """A flight recorder on every replica that lacks one (each on
+        its OWN engine clock — the black box must replay
+        bit-identically under that replica's fault plan)."""
+        for eng in self.replicas:
+            if eng.flight is None:
+                eng.attach_flight(capacity=capacity)
+
+    def merged_trace(self) -> dict:
+        """The fleet's recorders merged into ONE Perfetto-loadable
+        trace: router lane + every replica's lanes, handoff flow
+        arrows intact.  Requires :meth:`attach_tracers`."""
+        if self.tracer is None:
+            raise RuntimeError("call attach_tracers() first")
+        return merge_traces([self.tracer] + self._tracers)
+
+    def dump_debug(self) -> Dict[str, object]:
+        """Fleet-wide debug snapshot (the /debug/state payload): the
+        router's ledger plus every replica's
+        :meth:`~paddle_tpu.serving.engine.ServingEngine.dump_debug`
+        (invariant verdicts, stats, flight rings)."""
+        return {"router": self.stats_snapshot(),
+                "queue_depth": self.queue_depth,
+                "replicas": [eng.dump_debug() for eng in self.replicas]}
 
     def attach_metrics(self) -> Dict[str, object]:
         """One FRESH registry per replica (the engine's one-registry
@@ -261,8 +387,10 @@ class Router:
         return self._parts
 
     def scalars(self) -> Dict[str, float]:
-        """Cluster-rollup scalars (sum/min/max-combined across replicas;
-        per-replica quantiles don't aggregate and are dropped)."""
+        """Cluster-rollup scalars: counters sum, gauges min/max-combine,
+        and histogram BUCKETS merge before re-quantizing, so the
+        ``*_p50``/``*_p99`` here are true cluster quantiles (r16) —
+        identical to what one union registry would have reported."""
         from .metrics import aggregate_scalars
 
         if self._parts is None:

@@ -127,9 +127,16 @@ def handoff_state(eng, idx: int, with_payload: bool = True) -> dict:
     receiver rebases timestamps exactly like a snapshot restore does.
     ``with_payload=False`` is the DEGRADED form (handoff-phase fault):
     the request ships without KV and re-prefills on the decode replica —
-    greedy output is unchanged, only the recompute is paid again."""
+    greedy output is unchanged, only the recompute is paid again.
+
+    The record also carries a TRACE CONTEXT (r16): the rid plus the
+    exporting engine's monotonic span sequence.  The pair keys the
+    Chrome-trace flow arrow (``tracing.flow_id``) that stitches the
+    prefill span, the router pump and the decode ingest into one line
+    on the merged cluster timeline."""
     st = eng._slots[idx]
     payload = eng.pool.export_pages(st.pages) if with_payload else None
+    eng._span_seq += 1
     return {
         "version": SNAPSHOT_VERSION,
         "request": _request_state(st.request),
@@ -139,6 +146,7 @@ def handoff_state(eng, idx: int, with_payload: bool = True) -> dict:
         "nbytes": (eng.pool.payload_nbytes(payload)
                    if payload is not None else 0),
         "clock_now": float(eng._now()),
+        "trace": {"rid": int(st.request.rid), "seq": int(eng._span_seq)},
     }
 
 
@@ -178,6 +186,9 @@ def snapshot_engine(eng) -> dict:
             # intervals over — raw time.monotonic values are meaningless
             # across a process boundary (per-boot base)
             clock_now=float(eng._now()),
+            # handoff trace-context sequence (r16): restored engines keep
+            # minting unique flow ids instead of restarting at 0
+            span_seq=int(eng._span_seq),
             pending=[_finished_state(f) for f in eng._pending],
             # r15 handoff queues: inbox records re-serialize their live
             # Request; outbox entries are already wire dicts (numpy
@@ -305,6 +316,7 @@ def restore_engine(model, snap: dict, **overrides):
     eng._len = np.asarray(es["len"], np.int32).copy()
     eng._table = np.asarray(es["table"], np.int32).copy()
     eng.stats.update(es["stats"])
+    eng._span_seq = int(es.get("span_seq", 0))
     eng._pending = [FinishedRequest(**f) for f in es["pending"]]
     # r15 handoff queues (absent in older snapshots = empty): inbox
     # requests rebase like waiting ones; outbox wire dicts rebase their

@@ -65,12 +65,24 @@ class TenantConfig:
     contention); ``priority`` — strict admission tier, higher first;
     ``max_resident`` — max concurrently admitted requests (slot quota);
     ``max_waiting`` — max queued requests (per-tenant backpressure;
-    overflow rejects at enqueue)."""
+    overflow rejects at enqueue).
+
+    SLO targets (r16, all optional — a tenant without them costs no
+    metric series): ``ttft_slo_s`` budgets time-to-first-token,
+    ``e2e_slo_s`` budgets enqueue-to-terminal latency; each terminal is
+    judged against the set budgets and feeds the per-tenant attainment
+    gauge and fast/slow burn-rate windows
+    (:class:`~paddle_tpu.serving.metrics.SLOTracker`).
+    ``slo_objective`` is the attainment target the error budget derives
+    from (0.99 → a 1% budget)."""
 
     weight: float = 1.0
     priority: int = 0
     max_resident: Optional[int] = None
     max_waiting: Optional[int] = None
+    ttft_slo_s: Optional[float] = None
+    e2e_slo_s: Optional[float] = None
+    slo_objective: float = 0.99
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -79,6 +91,13 @@ class TenantConfig:
             raise ValueError("max_resident must be >= 1")
         if self.max_waiting is not None and self.max_waiting < 0:
             raise ValueError("max_waiting must be >= 0")
+        if self.ttft_slo_s is not None and self.ttft_slo_s <= 0:
+            raise ValueError("ttft_slo_s must be > 0")
+        if self.e2e_slo_s is not None and self.e2e_slo_s <= 0:
+            raise ValueError("e2e_slo_s must be > 0")
+        if not 0.0 < self.slo_objective < 1.0:
+            raise ValueError(
+                f"slo_objective must be in (0, 1), got {self.slo_objective}")
 
 
 def normalize_tenants(tenants) -> Dict[str, TenantConfig]:

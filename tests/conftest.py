@@ -59,6 +59,36 @@ def pytest_configure(config):
         "disagg: disaggregated multi-replica serving (router, "
         "prefill/decode handoff, cluster WFQ, double-buffered dispatch; "
         "tests/test_disagg.py) — CPU-runnable, included in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "obs: cluster-wide observability (merged cross-replica traces, "
+        "flight recorder, SLO burn rates, /debug surface; "
+        "tests/test_observability.py) — CPU-runnable, included in tier-1")
+
+
+# Modules that drive the 8-virtual-device pipeline engine (train_batch /
+# PipelineParallel).  jaxlib on this image flakily crashes natively
+# (SIGSEGV/SIGABRT in apply_primitive) when the pipeline scan programs
+# come back from the PERSISTENT compilation cache on a low-core host;
+# fresh compiles always pass.  Disable only the on-disk cache for these
+# modules — every other module keeps the cross-run speedup.
+_PIPELINE_TEST_MODULES = {
+    "test_distributed", "test_hapi_static", "test_pipeline_gpt",
+    "test_seq_major", "test_w8a8_gpt",
+}
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_cache_for_pipeline(request):
+    mod = getattr(request.node, "module", None)
+    if mod is None or mod.__name__ not in _PIPELINE_TEST_MODULES:
+        yield
+        return
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
 
 
 @pytest.fixture(autouse=True)

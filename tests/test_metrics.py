@@ -582,7 +582,9 @@ _SCOPED_ROOTS = {
     "socketserver": set(),
     "selectors": {"frontend.py", "router.py"},
     "ssl": set(),
-    "json": {"frontend.py", "tracing.py"},
+    # flight_recorder.py serializes its ring to canonical JSON (the
+    # bit-identical chaos-replay dump contract)
+    "json": {"frontend.py", "tracing.py", "flight_recorder.py"},
 }
 
 
@@ -660,8 +662,9 @@ def test_serving_runtime_modules_loaded_clean():
     """Belt to the AST braces: every serving module is already imported
     (this file imported the package) — none of the forbidden client
     libraries may have come along for the ride."""
-    for mod in ("metrics", "tracing", "kv_pool", "prefix_cache",
-                "scheduler", "engine", "faults", "snapshot", "drafter"):
+    for mod in ("metrics", "tracing", "flight_recorder", "kv_pool",
+                "prefix_cache", "scheduler", "engine", "faults",
+                "snapshot", "drafter"):
         assert f"paddle_tpu.serving.{mod}" in sys.modules
     for banned in ("tensorboard", "prometheus_client", "opentelemetry",
                    "tensorboardX", "visualdl"):

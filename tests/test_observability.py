@@ -1,0 +1,543 @@
+"""Cluster-wide observability (ISSUE r16 tentpole).
+
+Acceptance contracts, all CPU-runnable (``obs`` marker, tier-1):
+
+  * a routed 2-replica disaggregated run merges into ONE
+    Perfetto-loadable trace: prefill-export span, router pump span and
+    decode-ingest span live on DISTINCT pid lanes, stitched by flow
+    events (``s``/``t``/``f`` sharing a flow id), and
+    ``validate_trace`` passes on the merged result;
+  * the flight recorder is a bounded ring on the ENGINE clock — two
+    replays of one seeded chaos plan dump byte-identical black boxes,
+    and a real crash escaping ``step()`` dumps the ring before
+    re-raising;
+  * ``merge_registries`` / ``aggregate_scalars`` fold histogram
+    buckets, so cluster p50/p99 equal a single union registry's (the
+    oracle) — not dropped, not averaged;
+  * per-tenant SLO attainment + fast/slow burn-rate gauges judge every
+    terminal exactly once on the engine clock (deterministic under the
+    chaos virtual clock);
+  * the front end's read-only ``/debug`` surface (state / flight /
+    trace) is off by default and ``/healthz`` reports per-replica
+    ``last_step_age_s`` staleness.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import (FaultPlan, FlightRecorder, Request,
+                                ServingEngine, TenantConfig, make_cluster,
+                                merge_registries, validate_trace)
+from paddle_tpu.serving.metrics import (MetricsRegistry, SLOTracker,
+                                        _RollingWindow, aggregate_scalars)
+from paddle_tpu.serving.tracing import (PID_REQUESTS, PID_ROUTER,
+                                        PID_STRIDE, TraceRecorder)
+
+pytestmark = pytest.mark.obs
+
+CFG = dict(vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
+           max_seq_len=96, dropout=0.0)
+
+
+def _model(seed=3, **over):
+    paddle.seed(seed)
+    m = GPTForPretraining(GPTConfig(**{**CFG, **over}))
+    m.eval()
+    return m
+
+
+def _prompts(rng, lens, vocab=512):
+    return [rng.randint(0, vocab, (n,)).astype("int32") for n in lens]
+
+
+# ---------------------------------------------------------------------------
+# trace well-formedness + merge
+# ---------------------------------------------------------------------------
+
+
+def test_validate_trace_well_formedness():
+    clk = [0.0]
+    rec = TraceRecorder(clock=lambda: clk[0])
+    rec.process_name(1, "lane")
+    rec.begin("outer", 1, 7)
+    clk[0] = 1.0
+    rec.instant("tick", 1, 7)
+    rec.flow_start("hop", 1, 7, 42)
+    rec.end(1, 7)
+    rec.complete("phase", 0.5, 0.25, 1, 0)
+    rec.flow_finish("hop", 1, 8, 42)
+    counts = validate_trace(rec)
+    assert counts["B"] == counts["E"] == 1
+    assert counts["flows"] == 1 and counts["s"] == counts["f"] == 1
+
+    # unmatched E
+    with pytest.raises(ValueError, match="unmatched E"):
+        validate_trace([{"name": "x", "ph": "E", "ts": 0.0,
+                         "pid": 1, "tid": 1}])
+    # unclosed B
+    with pytest.raises(ValueError, match="unclosed"):
+        validate_trace([{"name": "x", "ph": "B", "ts": 0.0,
+                         "pid": 1, "tid": 1}])
+    # a flow start without a finish (and vice versa)
+    with pytest.raises(ValueError, match="exactly one s and one f"):
+        validate_trace([{"name": "h", "ph": "s", "ts": 0.0, "pid": 1,
+                         "tid": 1, "cat": "handoff", "id": 9}])
+    # negative duration
+    with pytest.raises(ValueError, match="negative dur"):
+        validate_trace([{"name": "x", "ph": "X", "ts": 0.0, "pid": 1,
+                         "tid": 1, "dur": -1.0}])
+    # the recorder itself refuses an unbalanced end
+    with pytest.raises(ValueError, match="no open span"):
+        rec.end(1, 99)
+
+
+def test_set_replica_namespaces_lanes():
+    rec = TraceRecorder()
+    rec.set_replica(3)
+    assert rec.pid(PID_REQUESTS) == 3 * PID_STRIDE + PID_REQUESTS
+    assert rec.lane_label("requests") == "r3: requests"
+    rec.process_name(rec.pid(PID_REQUESTS), rec.lane_label("requests"))
+    with pytest.raises(ValueError, match="set_replica must precede"):
+        rec.set_replica(4)
+    # no replica set: identity mapping (single-engine traces unchanged)
+    assert TraceRecorder().pid(PID_REQUESTS) == PID_REQUESTS
+
+
+def test_merge_traces_rebases_onto_earliest_t0():
+    from paddle_tpu.serving import merge_traces
+
+    clk = [10.0]
+    a = TraceRecorder(clock=lambda: clk[0])       # _t0 = 10
+    clk[0] = 13.0
+    b = TraceRecorder(clock=lambda: clk[0])       # _t0 = 13
+    a.process_name(1, "a")
+    b.process_name(11, "b")
+    clk[0] = 14.0
+    a.instant("ev_a", 1, 0)                       # 4s after a's t0
+    b.instant("ev_b", 11, 0)                      # 1s after b's t0
+    merged = merge_traces([a, b, None])
+    ts = {e["name"]: e["ts"] for e in merged["traceEvents"]
+          if e["ph"] == "i"}
+    # both fired at the same wall instant: identical ts after rebase
+    assert ts["ev_a"] == pytest.approx(4e6)
+    assert ts["ev_b"] == pytest.approx(4e6)
+    validate_trace(merged)
+
+
+def test_cluster_merged_trace_stitches_handoff_flows():
+    """THE tentpole acceptance: a 2-replica disaggregated run produces
+    one merged trace where every handoff is an s -> t -> f flow whose
+    ends sit on the prefill replica's, router's and decode replica's
+    DISTINCT lanes, in causal time order."""
+    model = _model()
+    router = make_cluster(model, 2, disaggregate=True, max_slots=2,
+                          page_size=8, num_pages=32)
+    router.attach_tracers()
+    rng = np.random.RandomState(5)
+    done = router.run([(p, 5) for p in _prompts(rng, [6, 11, 8])])
+    assert len(done) == 3
+    merged = router.merged_trace()
+    counts = validate_trace(merged)
+    assert counts["flows"] == 3 == router.stats["handoffs"]
+
+    evs = merged["traceEvents"]
+    pid_pre = 0 * PID_STRIDE + PID_REQUESTS     # prefill replica lane
+    pid_dec = 1 * PID_STRIDE + PID_REQUESTS     # decode replica lane
+    by_flow = {}
+    for e in evs:
+        if e["ph"] in ("s", "t", "f"):
+            by_flow.setdefault(e["id"], {})[e["ph"]] = e
+    for fid, legs in by_flow.items():
+        assert set(legs) == {"s", "t", "f"}
+        assert legs["s"]["pid"] == pid_pre
+        assert legs["t"]["pid"] == PID_ROUTER
+        assert legs["f"]["pid"] == pid_dec
+        assert legs["s"]["ts"] <= legs["t"]["ts"] <= legs["f"]["ts"]
+    # lanes carry replica-prefixed names; the router has its own
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert any(n.startswith("r0: ") for n in names)
+    assert any(n.startswith("r1: ") for n in names)
+    assert "router (routing + handoff pump)" in names
+    # the routing decision is visible with its WHY
+    routes = [e for e in evs if e["ph"] == "X" and e["name"] == "route"]
+    assert len(routes) == 3
+    assert all({"rid", "replica", "prefix_match_len", "load_score"}
+               <= set(r["args"]) for r in routes)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_canonical_dump(tmp_path):
+    clk = [0.0]
+    fl = FlightRecorder(capacity=3, clock=lambda: clk[0])
+    for i in range(5):
+        clk[0] = float(i)
+        fl.record("admit", i, rid=i)
+    assert len(fl) == 3 and fl.recorded == 5 and fl.dropped == 2
+    dump = fl.to_json()
+    assert [r["step"] for r in dump["records"]] == [2, 3, 4]
+    assert dump["records"][0]["t"] == 2.0
+    # canonical text: sorted keys, compact — replays compare byte-wise
+    text = fl.dumps()
+    assert text == json.dumps(json.loads(text), sort_keys=True,
+                              separators=(",", ":"))
+    path = fl.dump(str(tmp_path / "flight.json"))
+    assert open(path).read() == text
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def _chaos_flight_dump(seed):
+    model = _model()
+    plan = FaultPlan.random(seed, n_steps=24)
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=24,
+                        faults=plan, flight=True)
+    rng = np.random.RandomState(seed)
+    for i, p in enumerate(_prompts(rng, [6, 11, 8, 5])):
+        # explicit rids: the global allocator would differ across
+        # replays, and the black box records rids
+        eng._enqueue(Request(prompt=p, max_new_tokens=4,
+                             rid=1000 + i, deadline_s=0.5))
+    eng.run()
+    return eng.flight.dumps()
+
+
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_chaos_flight_dumps_bit_identical(seed):
+    """Two replays of one seeded chaos plan produce byte-identical
+    black boxes: every record is stamped on the plan's virtual clock
+    and every field is deterministic."""
+    a = _chaos_flight_dump(seed)
+    b = _chaos_flight_dump(seed)
+    assert a == b
+    kinds = {r["kind"] for r in json.loads(a)["records"]}
+    assert "admit" in kinds and "terminal" in kinds
+
+
+def test_flight_records_preemption_with_victim(rng):
+    model = _model()
+    # the r10 pressure shape: 6 usable pages of 8 cannot hold both
+    # residents' decode growth — the younger must be evicted
+    eng = ServingEngine(model, max_slots=2, page_size=8, num_pages=7,
+                        chunk_tokens=16, flight=True)
+    eng.add_request(rng.randint(0, 512, (8,)).astype("int32"), 24)
+    eng.add_request(rng.randint(0, 512, (16,)).astype("int32"), 16)
+    eng.run()
+    assert eng.stats["preemptions"] > 0
+    pre = [r for r in eng.flight.to_json()["records"]
+           if r["kind"] == "preempt"]
+    assert pre and all(r["reason"] == "page_pressure" and "victim" in r
+                       and r["pages_freed"] > 0 for r in pre)
+
+
+def test_crash_escaping_step_dumps_black_box(tmp_path, monkeypatch):
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8)
+    eng.add_request(np.arange(6, dtype=np.int32), 4)
+
+    def boom(self, finished):
+        raise RuntimeError("device fell over")
+
+    monkeypatch.setattr(ServingEngine, "_run_step", boom)
+    with pytest.raises(RuntimeError, match="device fell over"):
+        eng.run(metrics_dir=str(tmp_path))
+    dump = json.loads(open(tmp_path / "flight_crash.json").read())
+    last = dump["records"][-1]
+    assert last["kind"] == "crash"
+    assert "RuntimeError: device fell over" == last["error"]
+
+
+def test_dump_debug_reports_state_and_flight():
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8, flight=True)
+    eng.add_request(np.arange(5, dtype=np.int32), 3)
+    eng.run()
+    dbg = eng.dump_debug()
+    assert dbg["invariants"] == "ok" and dbg["role"] == "both"
+    assert dbg["flight"]["recorded"] == len(dbg["flight"]["records"])
+    assert dbg["stats"]["tokens_generated"] == 3
+
+
+# ---------------------------------------------------------------------------
+# registry merge vs. the union oracle
+# ---------------------------------------------------------------------------
+
+
+def test_merge_registries_matches_union_registry_oracle(rng):
+    """Cluster quantiles are REAL: merging per-replica registries gives
+    exactly the scalars of one registry fed the union of samples."""
+    parts = {f"replica{i}": MetricsRegistry() for i in range(3)}
+    oracle = MetricsRegistry()
+    oh = oracle.histogram("serving_step_s", "t")
+    oc = oracle.counter("serving_tokens_generated", "t")
+    og = oracle.gauge("serving_pages_in_use", "t")
+    for i, reg in enumerate(parts.values()):
+        h = reg.histogram("serving_step_s", "t")
+        c = reg.counter("serving_tokens_generated", "t")
+        g = reg.gauge("serving_pages_in_use", "t")
+        for v in rng.lognormal(-4, 2, size=50 + 30 * i):
+            h.observe(v)
+            oh.observe(v)
+        c.inc(10 * (i + 1))
+        oc.inc(10 * (i + 1))
+        g.set(5.0)
+        og.inc(5.0)
+    agg = aggregate_scalars(parts)
+    want = oracle.scalars()
+    assert set(agg) == set(want)
+    for k in want:
+        assert agg[k] == pytest.approx(want[k]), k
+    # p99 really came from buckets, not a dropped key
+    assert agg["serving_step_s_p99"] > agg["serving_step_s_p50"] > 0
+    # mismatched bucket bounds refuse to merge (silent nonsense is worse)
+    bad = MetricsRegistry()
+    bad.histogram("serving_step_s", "t", start=1e-3)
+    with pytest.raises(ValueError, match="bounds differ"):
+        merge_registries({"a": parts["replica0"], "b": bad})
+
+
+def test_merge_registries_is_deterministic_and_fresh():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("c", "h").inc(1)
+    b.counter("c", "h").inc(2)
+    m1 = merge_registries({"replica1": b, "replica0": a})
+    m2 = merge_registries({"replica0": a, "replica1": b})
+    assert m1.scalars() == m2.scalars() == {"c": 3.0}
+    # the rollup is a copy: mutating it never touches the parts
+    m1.counter("c", "h").inc(100)
+    assert a.scalars()["c"] == 1.0 and b.scalars()["c"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# SLO attainment + burn rate
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_window_pages_out_by_epoch():
+    w = _RollingWindow(60.0)
+    for t in range(10):
+        w.observe(float(t), ok=(t % 2 == 0))
+    assert w.bad_fraction(10.0) == pytest.approx(0.5)
+    # everything ages out of the trailing window
+    assert w.bad_fraction(10.0 + 120.0) == 0.0
+    # stale slots are zeroed on reuse, not double counted
+    w.observe(200.0, ok=False)
+    assert w.bad_fraction(200.0) == 1.0
+
+
+def test_slo_tracker_burn_rates_fast_and_slow():
+    reg = MetricsRegistry()
+    slo = SLOTracker(reg)
+    now = 0.0
+    for i in range(20):
+        slo.observe("a", "ttft", ok=(i != 0), now=now, objective=0.9)
+        now += 1.0
+    slo.sync(now)
+    sc = reg.scalars()
+    assert sc["serving_slo_total.slo=ttft.tenant=a"] == 20
+    assert sc["serving_slo_miss.slo=ttft.tenant=a"] == 1
+    assert sc["serving_slo_attainment.slo=ttft.tenant=a"] == \
+        pytest.approx(0.95)
+    # 1 bad / 20 in both windows; budget 0.1 -> burn 0.5
+    assert sc["serving_slo_burn_rate.slo=ttft.tenant=a.window=fast"] == \
+        pytest.approx(0.5)
+    assert sc["serving_slo_burn_rate.slo=ttft.tenant=a.window=slow"] == \
+        pytest.approx(0.5)
+    # the fast window forgets the miss long before the slow one
+    slo.sync(now + 300.0)
+    sc = reg.scalars()
+    assert sc["serving_slo_burn_rate.slo=ttft.tenant=a.window=fast"] == 0.0
+    assert sc["serving_slo_burn_rate.slo=ttft.tenant=a.window=slow"] == \
+        pytest.approx(0.5)
+
+
+def test_engine_judges_slo_at_terminal_funnel(rng):
+    """Every terminal is judged once against its tenant's budgets on
+    the engine clock: a stalled queue blows TTFT (miss) while a huge
+    e2e budget still attains; degraded terminals count as misses."""
+    clk = [0.0]
+    tenants = {"a": TenantConfig(ttft_slo_s=1.0, e2e_slo_s=1e9,
+                                 slo_objective=0.9)}
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8,
+                        tenants=tenants, clock=lambda: clk[0],
+                        metrics=True)
+    for p in _prompts(rng, [6, 9]):
+        eng.add_request(p, 3, tenant="a")
+    clk[0] = 10.0          # both requests sat "queued" 10s > 1s budget
+    eng.run()
+    sc = eng.metrics.scalars()
+    assert sc["serving_slo_total.slo=ttft.tenant=a"] == 2
+    assert sc["serving_slo_attainment.slo=ttft.tenant=a"] == 0.0
+    assert sc["serving_slo_attainment.slo=e2e.tenant=a"] == 1.0
+    # burn: 2/2 bad over budget 0.1 in both windows
+    assert sc["serving_slo_burn_rate.slo=ttft.tenant=a.window=fast"] == \
+        pytest.approx(10.0)
+    # a cancelled request is an e2e miss — shedding cannot game the SLO
+    rid = eng.add_request(np.arange(7, dtype=np.int32), 3, tenant="a")
+    eng.cancel(rid)
+    eng.step()
+    sc = eng.metrics.scalars()
+    assert sc["serving_slo_miss.slo=e2e.tenant=a"] == 1
+    # no-SLO tenants cost zero series
+    assert not any("tenant=b" in k for k in sc)
+
+
+def test_slo_off_without_declared_budgets(rng):
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8,
+                        tenants={"a": 2.0}, metrics=True)
+    assert eng._slo is None
+    eng.add_request(np.arange(5, dtype=np.int32), 3, tenant="a")
+    eng.run()
+    assert not any(k.startswith("serving_slo_") for k in
+                   eng.metrics.scalars())
+
+
+# ---------------------------------------------------------------------------
+# router artifacts + /debug surface
+# ---------------------------------------------------------------------------
+
+
+def test_router_run_writes_cluster_artifacts(tmp_path, rng):
+    model = _model()
+    router = make_cluster(model, 2, disaggregate=True, max_slots=2,
+                          page_size=8, num_pages=32)
+    router.run([(p, 4) for p in _prompts(rng, [6, 9])],
+               metrics_dir=str(tmp_path))
+    names = sorted(os.listdir(tmp_path))
+    assert {"cluster.prom", "metrics_r0.prom", "metrics_r1.prom",
+            "trace.json", "flight_r0.json", "flight_r1.json"} <= set(names)
+    page = open(tmp_path / "cluster.prom").read()
+    assert 'replica="replica0"' in page and 'replica="replica1"' in page
+    trace = json.loads(open(tmp_path / "trace.json").read())
+    counts = validate_trace(trace)
+    assert counts["flows"] == router.stats["handoffs"] > 0
+    fl = json.loads(open(tmp_path / "flight_r0.json").read())
+    assert fl["recorded"] > 0
+    assert any(r["kind"] == "handoff_out" for r in fl["records"])
+
+
+def test_debug_endpoints_and_healthz_staleness(rng):
+    import asyncio
+
+    from paddle_tpu.serving import ServingFrontend
+
+    model = _model()
+    router = make_cluster(model, 2, disaggregate=True, max_slots=2,
+                          page_size=8, num_pages=32, chunk_tokens=8)
+    router.attach_tracers()
+    router.attach_flight()
+    router.run([(np.arange(4, dtype=np.int32), 2)])   # warm + trace
+
+    async def _call(port, path):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write((f"GET {path} HTTP/1.1\r\nHost: t\r\n"
+                      "Content-Length: 0\r\n\r\n").encode())
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), 60.0)
+        writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return int(head.split()[1]), body
+
+    async def main():
+        on = await ServingFrontend(router, debug=True).start()
+        try:
+            state = await _call(on.port, "/debug/state")
+            flight = await _call(on.port, "/debug/flight?replica=1")
+            bad_rep = await _call(on.port, "/debug/flight?replica=9")
+            trace = await _call(on.port, "/debug/trace")
+            health = await _call(on.port, "/healthz")
+            missing = await _call(on.port, "/debug/nope")
+        finally:
+            await on.stop()
+        off = await ServingFrontend(router, debug=False).start()
+        try:
+            dark = await _call(off.port, "/debug/state")
+        finally:
+            await off.stop()
+        return state, flight, bad_rep, trace, health, missing, dark
+
+    (state, flight, bad_rep, trace, health, missing, dark) = \
+        asyncio.run(main())
+    st, body = state
+    assert st == 200
+    payload = json.loads(body)
+    assert [r["invariants"] for r in payload["replicas"]] == ["ok", "ok"]
+    # state carries flight SUMMARIES only; the ring has its own endpoint
+    assert "records" not in payload["replicas"][0]["flight"]
+    fs, fbody = flight
+    assert fs == 200
+    ring = json.loads(fbody)
+    assert ring["recorded"] == len(ring["records"]) > 0
+    assert bad_rep[0] == 400
+    ts, tbody = trace
+    assert ts == 200
+    counts = validate_trace(json.loads(tbody))
+    assert counts["flows"] > 0
+    hs, hbody = health
+    assert hs == 200
+    ages = json.loads(hbody)["last_step_age_s"]
+    assert len(ages) == 2 and all(a is not None and a >= 0 for a in ages)
+    assert missing[0] == 404
+    # off by default: indistinguishable from not existing
+    assert dark[0] == 404
+
+
+def test_healthz_staleness_null_before_first_step():
+    import asyncio
+
+    from paddle_tpu.serving import ServingFrontend
+
+    model = _model()
+    eng = ServingEngine(model, max_slots=2, page_size=8)
+
+    async def main():
+        fe = await ServingFrontend(eng).start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", fe.port)
+            writer.write(b"GET /healthz HTTP/1.1\r\nHost: t\r\n"
+                         b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), 60.0)
+            writer.close()
+        finally:
+            await fe.stop()
+        return raw.partition(b"\r\n\r\n")[2]
+
+    body = json.loads(asyncio.run(main()))
+    assert body["last_step_age_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# trace context survives snapshot/restore
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_trace_context_survives_snapshot(rng):
+    """An exported-but-unpumped handoff record keeps its (rid, seq)
+    trace context across snapshot/restore, and the restored engine's
+    span sequence resumes past it (no flow-id reuse)."""
+    model = _model()
+    kw = dict(max_slots=2, page_size=8, num_pages=32)
+    pre = ServingEngine(model, role="prefill", **kw)
+    p = rng.randint(0, 512, (6,)).astype("int32")
+    rid = pre.add_request(p, 4)
+    while not pre._handoff_out:
+        pre.step()
+    seq_before = pre._span_seq
+    assert seq_before > 0
+    snap = pre.snapshot()
+    pre2 = ServingEngine.restore(model, snap)
+    assert pre2._span_seq == seq_before
+    h = pre2.drain_handoffs()[0]
+    assert h["trace"] == {"rid": rid, "seq": seq_before}
