@@ -1,0 +1,34 @@
+"""paddle_tpu.analysis — graftlint, the static-analysis pass suite.
+
+The reference fork's IR-pass layer (102 pass files inspecting the graph
+before execution) maps here onto two kinds of static analysis:
+
+* **AST passes over the source tree** (:mod:`.astlint` is the pass
+  manager; one module per rule): ``import-guard`` (serving's
+  no-new-deps / scoped-network contract), ``determinism`` (injectable
+  clock + seeded RNG discipline), ``trace-safety`` (host-sync hazards
+  in jit-reachable code), ``metrics-docs`` (README metric table ==
+  registered families).
+* **jaxpr audits** (:mod:`.jaxpr_audit`): the one walker library behind
+  every layout/dtype contract the tests assert (transpose-free kernels,
+  no-f64 promotion, jaxpr identity).
+
+Run the linter::
+
+    python -m paddle_tpu.analysis                  # whole repo, text
+    python -m paddle_tpu.analysis --format=json    # machine-readable
+    python -m paddle_tpu.analysis --rule determinism paddle_tpu/serving
+
+Suppress a finding inline, with its justification::
+
+    self._clock = time.monotonic  # graftlint: allow=determinism — fallback only
+
+Tier-1 runs the whole suite (``tests/test_analysis.py``) and fails on
+any unsuppressed finding.
+"""
+
+from .astlint import (Finding, Project, Rule, SourceModule,  # noqa: F401
+                      all_rules, load_project, register, run)
+
+__all__ = ["Finding", "Project", "Rule", "SourceModule",
+           "all_rules", "load_project", "register", "run"]

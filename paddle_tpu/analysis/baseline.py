@@ -1,0 +1,55 @@
+"""Package-scoped legacy allowances for the graftlint pass suite.
+
+Legacy trees predate the determinism discipline: the vision transforms
+draw from the process-global ``random`` module (the reference's
+augmentation semantics), the io shufflers use global ``np.random``, the
+launch/elastic/auto-checkpoint machinery polls ``time.time()`` deadlines
+on the host, and the tensorboard writer stamps real wall time because
+the TF event-file format says so.  Rewriting them is out of scope (and
+some of it — tensorboard walltime — would be wrong); littering them
+with per-line suppressions would bury the signal.
+
+Instead this baseline records, per (file, rule, symbol), how many
+findings are ACCEPTED.  The runner marks exactly that many as
+``baselined``; the next occurrence of the same pattern in the same file
+— i.e. NEW code repeating the legacy habit — is an active finding and
+fails the run.  Counts are stable under unrelated edits (line numbers
+are not), which is why the key is the symbol, not the location.
+
+Shrink-only: when legacy code is cleaned up, delete its entry.  Never
+grow an entry to paper over new code — new code gets fixed, or in a
+genuinely justified case an inline ``# graftlint: allow=`` with its
+reason.
+"""
+
+#: rule name -> {(repo-relative path, finding key): allowed count}
+BASELINE = {
+    "determinism": {
+        # host-side deadline polling in process launch/monitor loops;
+        # these predate the injectable-clock convention (r10) and never
+        # interact with the serving replay guarantees
+        ("paddle_tpu/distributed/launch_utils.py", "time.time"): 4,
+        ("paddle_tpu/distributed/spawn.py", "time.time"): 2,
+        ("paddle_tpu/distributed/fleet/elastic.py", "time.time"): 2,
+        ("paddle_tpu/incubate/auto_checkpoint.py", "time.time"): 3,
+        # progress bar ETA: display-only wall clock
+        ("paddle_tpu/hapi/progressbar.py", "time.time"): 1,
+        # TF event-file records REQUIRE real walltime stamps
+        ("paddle_tpu/utils/tensorboard.py", "time.time"): 3,
+        # reference-parity vision augmentation draws from the global
+        # `random` module exactly like the original transforms
+        ("paddle_tpu/vision/transforms/__init__.py", "random.randint"): 4,
+        ("paddle_tpu/vision/transforms/__init__.py", "random.uniform"): 5,
+        ("paddle_tpu/vision/transforms/__init__.py", "random.random"): 2,
+        ("paddle_tpu/vision/transforms/__init__.py", "random.shuffle"): 1,
+        ("paddle_tpu/vision/transforms/__init__.py", "random.choice"): 1,
+        # io/reader shufflers mirror the reference's global-seed behavior
+        ("paddle_tpu/reader/__init__.py", "random.shuffle"): 2,
+        ("paddle_tpu/io/__init__.py", "numpy.random.permutation"): 2,
+        ("paddle_tpu/io/__init__.py", "numpy.random.randint"): 1,
+        ("paddle_tpu/io/__init__.py", "numpy.random.choice"): 1,
+        # RNG-tracker default seeds when the user supplies none
+        ("paddle_tpu/distributed/fleet/meta_parallel/mp_layers.py",
+         "numpy.random.randint"): 2,
+    },
+}

@@ -1,0 +1,113 @@
+"""determinism-discipline: no ambient wall clocks, no unseeded RNG.
+
+Every chaos-replay / flight-recorder / snapshot-restore guarantee in
+r10–r16 rests on two conventions nothing checked statically until now:
+
+* **Time** flows from an injectable clock (``ServingEngine(clock=)``,
+  ``FaultPlan.now``, ``TraceRecorder(clock=)``) — never read directly
+  from ``time.time()`` / ``time.monotonic()`` / ``datetime.now()`` at a
+  decision site.  ``time.perf_counter`` stays sanctioned: it feeds the
+  wall-time observability histograms (``serving_step_s`` …), which
+  measure the host, never steer it.
+* **Randomness** flows from seeded generators — ``jax.random`` keys,
+  ``np.random.RandomState(seed)`` / ``default_rng(seed)``, the seeded
+  ``FaultPlan`` — never the process-global ``random.*`` /
+  ``np.random.*`` state.
+
+This pass flags raw call sites of the ambient sources, plus bare
+*references* to the wall clocks (binding ``time.monotonic`` as a
+fallback is the one sanctioned idiom, and those two sites carry inline
+suppressions explaining exactly that).  Legacy trees (``fluid/``,
+``distributed/launch_utils.py``, ``incubate/``, ``hapi/``, vision
+transforms, the io shufflers, the tensorboard event stamper) predate
+the discipline and are carried by the package-scoped baseline — new
+code in them is still checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional
+
+from .astlint import (Finding, Rule, SourceModule, collect_imports,
+                      register, resolve_name)
+
+#: ambient wall-clock reads (decision-site hazards).  perf_counter is
+#: deliberately absent — see the module docstring.
+BANNED_CLOCKS = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+#: numpy.random constructors that are fine WHEN GIVEN A SEED
+SEEDED_NP_CTORS = {"RandomState", "default_rng", "Generator",
+                   "SeedSequence", "PCG64", "Philox"}
+
+_CLOCK_HINT = ("inject the engine clock (ServingEngine(clock=) / "
+               "FaultPlan.now) instead — chaos replays and "
+               "flight-recorder dumps must be bit-identical")
+_RNG_HINT = ("use a seeded generator (jax.random key, "
+             "np.random.RandomState(seed), default_rng(seed)) — "
+             "process-global RNG state breaks replay determinism")
+
+
+def _dotted(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    return resolve_name(node, imports)
+
+
+@register
+class DeterminismRule(Rule):
+    name = "determinism"
+    description = ("flag ambient wall-clock reads and unseeded global "
+                   "RNG; the injectable clock and seeded generators are "
+                   "the only sanctioned sources")
+    # repo-wide: serving/kernels/models are expected to be clean; legacy
+    # trees are carried by the baseline, not exempted from the pass.
+    scope = ()
+
+    def check_module(self, module: SourceModule) -> Iterable[Finding]:
+        imports = collect_imports(module.tree)
+        call_funcs = set()          # Attribute/Name nodes used as callees
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                call_funcs.add(id(node.func))
+                yield from self._check_call(module, node, imports)
+        # bare references to clocks (bound/passed, not called)
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.Attribute, ast.Name)) \
+                    and id(node) not in call_funcs:
+                name = _dotted(node, imports)
+                if name in BANNED_CLOCKS:
+                    yield Finding(
+                        module.relpath, node.lineno, self.name,
+                        f"binds ambient clock {name} as a value — "
+                        f"{_CLOCK_HINT}", key=name)
+
+    def _check_call(self, module: SourceModule, node: ast.Call,
+                    imports: Dict[str, str]) -> Iterable[Finding]:
+        # resolve_name also covers bare from-imports, e.g. `from random
+        # import random; random()`
+        name = _dotted(node.func, imports)
+        if name is None:
+            return
+        if name in BANNED_CLOCKS:
+            yield Finding(module.relpath, node.lineno, self.name,
+                          f"raw {name}() call — {_CLOCK_HINT}", key=name)
+            return
+        parts = name.split(".")
+        if parts[0] == "random" and len(parts) >= 2:
+            # stdlib random module: everything is global-state except a
+            # seeded private generator instance
+            if parts[1] == "Random" and (node.args or node.keywords):
+                return
+            yield Finding(module.relpath, node.lineno, self.name,
+                          f"global-state {name}() call — {_RNG_HINT}",
+                          key=name)
+        elif name.startswith("numpy.random.") and len(parts) >= 3:
+            if parts[2] in SEEDED_NP_CTORS and (node.args or node.keywords):
+                return
+            yield Finding(module.relpath, node.lineno, self.name,
+                          f"global-state {name}() call — {_RNG_HINT}",
+                          key=name)

@@ -1,0 +1,104 @@
+"""import-guard: the serving package's no-new-deps contract, as a pass.
+
+Grown from the guard that lived inside ``tests/test_metrics.py`` (r11;
+scoped network roots r12/r15) — the config below IS that guard, now
+shipped next to the code it protects so the test and the rule can never
+drift: the test is a thin invocation of this rule.
+
+Contract: ``paddle_tpu/serving/`` must stay importable (and auditable)
+with only jax / numpy / stdlib — observability cannot drag in
+tensorboard / prometheus / opentelemetry client deps — and the network
+stdlib is scoped file-by-file: a scheduler or engine change that starts
+talking to the network fails HERE, not in a security review.  The int4
+pack/unpack helpers (``ops/quant_ops.py``) sit on the serving-critical
+import path and carry the same discipline (plus paddle_tpu-relative
+imports, since they live outside the package).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Dict, Iterable, Set
+
+from .astlint import Finding, Rule, SourceModule, register
+
+#: absolute import roots every guarded file may use
+ALLOWED_ROOTS: Set[str] = {"jax", "numpy"}
+
+#: stdlib roots SCOPED to specific serving files: the network surface
+#: lives in frontend.py and ONLY there; the routing tier (router.py) is
+#: the only other file allowed to grow a transport (r15 — today it is
+#: in-process and imports none of these, but the scope records where
+#: one may live).  json predates the front end in tracing.py (the
+#: Chrome trace writer); flight_recorder.py serializes its ring to
+#: canonical JSON (the bit-identical chaos-replay dump contract).
+#: Keys are import roots, values the allowed basenames — an empty set
+#: means "banned everywhere in serving" (named so the intent is
+#: explicit rather than falling through to the stdlib default).
+SCOPED_ROOTS: Dict[str, Set[str]] = {
+    "asyncio": {"frontend.py", "router.py"},
+    "http": {"frontend.py"},
+    "socket": {"frontend.py", "router.py"},
+    "socketserver": set(),
+    "selectors": {"frontend.py", "router.py"},
+    "ssl": set(),
+    "json": {"frontend.py", "tracing.py", "flight_recorder.py"},
+}
+
+SERVING_PREFIX = "paddle_tpu/serving/"
+
+#: files outside serving/ that carry the serving import discipline;
+#: these MAY import paddle_tpu absolutely (they live in other packages)
+EXTRA_FILES: Set[str] = {"paddle_tpu/ops/quant_ops.py"}
+
+
+def _stdlib(root: str) -> bool:
+    return root in sys.stdlib_module_names
+
+
+def _allowed(root: str, basename: str, paddle_ok: bool) -> bool:
+    if root in SCOPED_ROOTS:
+        return basename in SCOPED_ROOTS[root]
+    if paddle_ok and root == "paddle_tpu":
+        return True
+    return _stdlib(root) or root in ALLOWED_ROOTS
+
+
+@register
+class ImportGuardRule(Rule):
+    name = "import-guard"
+    description = ("serving/ (and ops/quant_ops.py) import only "
+                   "jax/numpy/stdlib, with network stdlib scoped to the "
+                   "front end / router")
+    scope = (SERVING_PREFIX,) + tuple(EXTRA_FILES)
+
+    def check_module(self, module: SourceModule) -> Iterable[Finding]:
+        basename = module.relpath.rsplit("/", 1)[-1]
+        paddle_ok = module.relpath in EXTRA_FILES
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                targets = [(alias.name.split(".")[0], alias.name)
+                           for alias in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level > 0:          # relative: stays in paddle_tpu
+                    continue
+                mod = node.module or ""
+                targets = [(mod.split(".")[0], mod)]
+            else:
+                continue
+            for root, full in targets:
+                if _allowed(root, basename, paddle_ok):
+                    continue
+                if root in SCOPED_ROOTS:
+                    ok_in = sorted(SCOPED_ROOTS[root]) or ["nowhere"]
+                    msg = (f"import of '{full}' is scoped to "
+                           f"{'/'.join(ok_in)}, not {basename} — the "
+                           f"serving network surface is confined by "
+                           f"design")
+                else:
+                    msg = (f"import of '{full}' pulls a non-jax/numpy/"
+                           f"stdlib dependency into the serving-critical "
+                           f"path")
+                yield Finding(module.relpath, node.lineno, self.name,
+                              msg, key=root)

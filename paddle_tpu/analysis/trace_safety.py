@@ -1,0 +1,286 @@
+"""trace-safety: host-sync hazards in jit-reachable code.
+
+A function is *jit-reachable* when tracing can execute its body: it is
+decorated with (or passed to) a jax transform — ``jax.jit``,
+``pallas_call``, ``lax.scan/while_loop/cond/fori_loop/switch``,
+``vmap`` / ``grad`` / ``remat`` / ``custom_vjp`` … — or it is called
+(by name, same module) from such a function.  Inside that set, four
+patterns either crash at trace time (``TracerConversionError``,
+``TracerBoolConversionError``) or, worse, silently force a device→host
+sync that stalls the dispatch pipeline the serving engine exists to
+keep full:
+
+* ``x.item()`` — explicit device→host transfer;
+* ``float(x)`` / ``int(x)`` on a value that is not statically known
+  (shapes, ``len()``, literals and arithmetic over them are fine);
+* ``np.asarray(x)`` / ``np.array(x)`` — materializes a traced array on
+  host (the jnp reference paths must stay in jnp);
+* bare ``assert`` — on a traced boolean this either raises at trace
+  time or, under ``python -O``, vanishes; invariants over traced values
+  belong in ``checkify`` or the host-side ``check_invariants``.
+
+The detection is deliberately static and conservative: a flagged line
+in a function that is genuinely host-only at runtime earns an inline
+``# graftlint: allow=trace-safety`` with its justification — that
+comment is exactly the reviewable record the engine's ``interpret=``
+fallbacks rely on today.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .astlint import (Finding, Rule, SourceModule, collect_imports,
+                      register, resolve_name)
+
+#: final attribute of a jax-rooted callee that takes traceable callables
+TRANSFORMS = {
+    "jit", "pallas_call", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "map", "associative_scan", "vmap", "pmap", "grad",
+    "value_and_grad", "remat", "checkpoint", "custom_vjp", "custom_jvp",
+    "named_call", "shard_map", "pure_callback_abstract",  # last: none today
+}
+
+#: decorator heads that mark the function jit-reachable even when the
+#: dotted chain cannot be resolved to jax (e.g. a local `partial` of a
+#: kernel wrapper)
+_DECOR_TAILS = TRANSFORMS - {"map"}
+
+
+def _tail(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+class _Scope:
+    """One lexical scope: local function defs, simple assignments, and a
+    parent link.  Assignments feed the one-hop dataflow that resolves
+    the ``kernel = functools.partial(_paged_kernel, …);
+    pl.pallas_call(kernel, …)`` idiom back to the kernel def."""
+
+    def __init__(self, parent: Optional["_Scope"] = None):
+        self.parent = parent
+        self.funcs: Dict[str, ast.AST] = {}
+        self.assigns: Dict[str, ast.AST] = {}
+
+    def lookup(self, name: str) -> Optional[ast.AST]:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if name in s.funcs:
+                return s.funcs[name]
+            s = s.parent
+        return None
+
+    def lookup_assign(self, name: str) -> Optional[ast.AST]:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if name in s.assigns:
+                return s.assigns[name]
+            s = s.parent
+        return None
+
+
+def _is_static(node: ast.AST) -> bool:
+    """Conservatively true when the expression is trace-time constant:
+    literals, shape/dtype metadata, len(), and arithmetic over those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in {"ndim", "size", "dtype", "itemsize",
+                             "shape", "nbytes"}
+    if isinstance(node, ast.Subscript):
+        return _is_static(node.value)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in \
+                {"len", "min", "max", "abs", "round", "sum", "ord"}:
+            return all(_is_static(a) for a in node.args)
+        if isinstance(node.func, ast.Attribute) and node.func.attr in \
+                {"get", "prod", "bit_length"}:
+            return True
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_static(node.left) and _is_static(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static(node.operand)
+    if isinstance(node, ast.IfExp):
+        return _is_static(node.body) and _is_static(node.orelse)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_static(e) for e in node.elts)
+    return False
+
+
+@register
+class TraceSafetyRule(Rule):
+    name = "trace-safety"
+    description = ("flag .item() / float()/int() / np.asarray / bare "
+                   "assert inside jit-reachable functions (host-sync "
+                   "and trace-break hazards)")
+    scope = ("paddle_tpu/kernels/", "paddle_tpu/models/",
+             "paddle_tpu/serving/", "paddle_tpu/ops/")
+
+    # -- jit-reachability ---------------------------------------------------
+
+    def _index(self, module: SourceModule):
+        """Build (function -> scope), (function -> local callees by
+        Name), and the seed set of jit-entry functions."""
+        imports = collect_imports(module.tree)
+        fn_scope: Dict[ast.AST, _Scope] = {}
+        seeds: Set[ast.AST] = set()
+        edges: Dict[ast.AST, Set[ast.AST]] = {}
+
+        module_scope = _Scope()
+
+        def visit(node: ast.AST, scope: _Scope,
+                  owner: Optional[ast.AST]) -> None:
+            children = list(ast.iter_child_nodes(node))
+            # register defs BEFORE scanning bodies so forward references
+            # (a body calling a function defined later) resolve
+            for child in children:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    scope.funcs[child.name] = child
+            for child in children:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = _Scope(scope)
+                    fn_scope[child] = inner
+                    if self._marked_by_decorator(child, imports):
+                        seeds.add(child)
+                    visit(child, inner, child)
+                elif isinstance(child, ast.Lambda):
+                    # lambdas passed to transforms are traced too, but
+                    # they cannot contain statements; their expression
+                    # hazards surface via the Call checks on the owner
+                    visit(child, scope, owner)
+                else:
+                    if isinstance(child, ast.Assign) \
+                            and len(child.targets) == 1 \
+                            and isinstance(child.targets[0], ast.Name):
+                        scope.assigns[child.targets[0].id] = child.value
+                    if isinstance(child, ast.Call):
+                        self._scan_call(child, scope, owner, imports,
+                                        seeds, edges)
+                    visit(child, scope, owner)
+
+        visit(module.tree, module_scope, None)
+
+        # propagate: anything a marked function calls (by local name)
+        # is traced with it
+        marked = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            fn = frontier.pop()
+            for callee in edges.get(fn, ()):
+                if callee not in marked:
+                    marked.add(callee)
+                    frontier.append(callee)
+        return marked
+
+    def _marked_by_decorator(self, fn, imports) -> bool:
+        for dec in fn.decorator_list:
+            head = dec.func if isinstance(dec, ast.Call) else dec
+            name = resolve_name(head, imports)
+            if name is not None and name.startswith("jax") \
+                    and _tail(name) in TRANSFORMS:
+                return True
+            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+            if isinstance(dec, ast.Call):
+                for arg in dec.args:
+                    an = resolve_name(arg, imports)
+                    if an is not None and an.startswith("jax") \
+                            and _tail(an) in _DECOR_TAILS:
+                        return True
+        return False
+
+    def _scan_call(self, call: ast.Call, scope: _Scope,
+                   owner, imports, seeds: Set, edges: Dict) -> None:
+        # local call edge: f(...) where f is a same-module function
+        if isinstance(call.func, ast.Name) and owner is not None:
+            target = scope.lookup(call.func.id)
+            if target is not None:
+                edges.setdefault(owner, set()).add(target)
+        # transform reference: jax.jit(f) / lax.scan(f, ...) /
+        # pl.pallas_call(kernel, ...) / f.defvjp(fwd, bwd)
+        callee = resolve_name(call.func, imports)
+        is_transform = (callee is not None and callee.startswith("jax")
+                        and _tail(callee) in TRANSFORMS)
+        is_defvjp = (isinstance(call.func, ast.Attribute)
+                     and call.func.attr in {"defvjp", "defjvp"})
+        if not (is_transform or is_defvjp):
+            return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in args:
+            for target in self._callable_defs(arg, scope, set()):
+                seeds.add(target)
+
+    def _callable_defs(self, expr: ast.AST, scope: _Scope,
+                       seen: Set[str]):
+        """Function defs an argument expression can denote: bare names,
+        names inside wrapper calls (``partial(f, …)``), dict/conditional
+        selections, and — via the scope's assignment table — local
+        variables holding any of those."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Name) or node.id in seen:
+                continue
+            seen.add(node.id)
+            target = scope.lookup(node.id)
+            if target is not None:
+                yield target
+                continue
+            assigned = scope.lookup_assign(node.id)
+            if assigned is not None:
+                yield from self._callable_defs(assigned, scope, seen)
+
+    # -- hazard checks ------------------------------------------------------
+
+    def check_module(self, module: SourceModule) -> Iterable[Finding]:
+        imports = collect_imports(module.tree)
+        marked = self._index(module)
+        for fn in marked:
+            yield from self._check_body(module, fn, imports)
+
+    def _check_body(self, module: SourceModule, fn,
+                    imports) -> Iterable[Finding]:
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue        # separately marked (or host-only)
+                yield child
+                yield from walk(child)
+
+        where = f"jit-reachable `{fn.name}`"
+        for node in walk(fn):
+            if isinstance(node, ast.Assert):
+                yield Finding(
+                    module.relpath, node.lineno, self.name,
+                    f"bare assert in {where} — a traced boolean raises "
+                    f"at trace time (and vanishes under -O); use "
+                    f"checkify or host-side invariant checks",
+                    key="assert")
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    yield Finding(
+                        module.relpath, node.lineno, self.name,
+                        f".item() in {where} forces a device->host "
+                        f"sync (TracerConversionError under jit)",
+                        key="item")
+                    continue
+                name = resolve_name(node.func, imports)
+                if name in {"numpy.asarray", "numpy.array"}:
+                    yield Finding(
+                        module.relpath, node.lineno, self.name,
+                        f"{name}() in {where} materializes a traced "
+                        f"array on host — keep reference paths in jnp",
+                        key=name)
+                    continue
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in {"float", "int", "bool"} \
+                        and node.args and not _is_static(node.args[0]):
+                    yield Finding(
+                        module.relpath, node.lineno, self.name,
+                        f"{node.func.id}() on a possibly-traced value "
+                        f"in {where} — host-syncs (or raises) under "
+                        f"jit; compute in jnp or mark the value static",
+                        key=node.func.id)

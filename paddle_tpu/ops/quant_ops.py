@@ -17,6 +17,7 @@ decodes through (GPTConfig.int8), with an STE backward so
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -309,9 +310,7 @@ def w8a8_apply(x, wq, ws, out_dtype=None):
     lead = x.shape[:-1]
     k = x.shape[-1]
     n = wq.shape[-1]
-    m = 1
-    for s in lead:
-        m *= int(s)
+    m = math.prod(lead)        # shape dims: static under trace
     if int8_gemm.available() and int8_gemm.supported(m, k, n):
         out = int8_gemm.w8a8_gemm(x.reshape(m, k), wq, ws)
     else:

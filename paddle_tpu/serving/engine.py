@@ -352,7 +352,10 @@ class ServingEngine:
         elif faults is not None:
             self._clock = faults.now
         else:
-            self._clock = time.monotonic
+            # the ONE sanctioned wall-clock binding: when neither an
+            # explicit clock nor a FaultPlan is injected, real time is
+            # the semantics (production); replay paths always inject
+            self._clock = time.monotonic  # graftlint: allow=determinism
         dtype = self.params["wte"].dtype
         n_pages = num_pages or (1 + max_slots * self.max_pages)
         self.pool = KVPool(cfg.num_layers, cfg.num_heads, self.head_dim,

@@ -41,7 +41,9 @@ class FlightRecorder:
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         self.capacity = int(capacity)
-        self._clock = clock or time.monotonic
+        # sanctioned fallback binding: attach_flight always injects the
+        # engine clock; a standalone recorder defaults to real time
+        self._clock = clock or time.monotonic  # graftlint: allow=determinism
         self._t0 = self._clock()
         self._records = collections.deque(maxlen=self.capacity)
         self.recorded = 0

@@ -64,6 +64,11 @@ def pytest_configure(config):
         "obs: cluster-wide observability (merged cross-replica traces, "
         "flight recorder, SLO burn rates, /debug surface; "
         "tests/test_observability.py) — CPU-runnable, included in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "analysis: graftlint static-analysis suite (rule unit tests on "
+        "fixture snippets + the zero-unsuppressed-findings repo gate; "
+        "tests/test_analysis.py) — pure-python, included in tier-1")
 
 
 # Modules that drive the 8-virtual-device pipeline engine (train_batch /

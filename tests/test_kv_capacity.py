@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
+# primitive walks (pallas bodies excluded) live in the analysis package
+from paddle_tpu.analysis.jaxpr_audit import count_primitive
 from paddle_tpu.kernels import flash
 from paddle_tpu.kernels import paged_attention as pa
 from paddle_tpu.kernels import paged_prefill as pp
@@ -210,24 +212,6 @@ def test_gather_pages_int4_matches_manual_dequant():
 # ---------------------------------------------------------------------------
 
 
-def _count_primitive(jaxpr, name, stop_inside="pallas_call"):
-    n = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == name:
-            n += 1
-        if eqn.primitive.name == stop_inside:
-            continue
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            for u in vs:
-                inner = getattr(u, "jaxpr", None)
-                if inner is not None and hasattr(inner, "eqns"):
-                    n += _count_primitive(inner, name, stop_inside)
-                elif hasattr(u, "eqns"):
-                    n += _count_primitive(u, name, stop_inside)
-    return n
-
-
 def test_flash_sbnd_gqa_window_no_transposes():
     """The sbnd flash entry consumes GQA K/V in place — query-head groups
     gather onto the shared K/V head inside the BlockSpec index maps, so
@@ -241,8 +225,8 @@ def test_flash_sbnd_gqa_window_no_transposes():
         jx = jax.make_jaxpr(lambda q, k, v: flash.flash_attention(
             q, k, v, causal=True, layout="sbnd", window=window,
             interpret=True))(q, k, v)
-        assert _count_primitive(jx.jaxpr, "pallas_call") >= 1
-        assert _count_primitive(jx.jaxpr, "transpose") == 0
+        assert count_primitive(jx, "pallas_call") >= 1
+        assert count_primitive(jx, "transpose") == 0
 
 
 def test_ring_gqa_adds_zero_transposes():
@@ -259,7 +243,7 @@ def test_ring_gqa_adds_zero_transposes():
     def probe(k):
         jx = jax.make_jaxpr(lambda q, k: ring_attention(
             q, k, k, causal=True, use_flash=False, window=16))(q, k)
-        return _count_primitive(jx.jaxpr, "transpose")
+        return count_primitive(jx, "transpose")
 
     assert probe(kg) <= probe(kf)
 

@@ -12,9 +12,7 @@ the no-new-imports guard keeping ``paddle_tpu.serving`` on
 jax/numpy/stdlib only.
 """
 
-import ast
 import json
-import os
 import sys
 from collections import Counter as TallyCounter
 from collections import defaultdict
@@ -561,41 +559,10 @@ def test_engine_off_by_default_pays_nothing():
 
 
 # ---------------------------------------------------------------------------
-# no-new-imports guard
+# no-new-imports guard — the policy itself (allowed roots, per-file
+# network scoping) lives in paddle_tpu/analysis/import_guard.py; these
+# tests are thin invocations keeping the contract on the tier-1 path.
 # ---------------------------------------------------------------------------
-
-#: absolute imports paddle_tpu.serving modules may use
-_ALLOWED_ROOTS = {"jax", "numpy"}
-
-#: stdlib modules that are SCOPED to specific serving files (r12): the
-#: network surface lives in frontend.py and ONLY there — the engine,
-#: scheduler, pool etc. must stay importable (and auditable) without any
-#: I/O machinery.  json predates the front end in tracing.py (the Chrome
-#: trace writer).  Keys are import roots, values the allowed basenames.
-_SCOPED_ROOTS = {
-    # r15: the routing tier (router.py) is the only other file allowed
-    # to grow a network surface — today it is in-process and imports
-    # none of these, but the scope records where a transport may live
-    "asyncio": {"frontend.py", "router.py"},
-    "http": {"frontend.py"},
-    "socket": {"frontend.py", "router.py"},
-    "socketserver": set(),
-    "selectors": {"frontend.py", "router.py"},
-    "ssl": set(),
-    # flight_recorder.py serializes its ring to canonical JSON (the
-    # bit-identical chaos-replay dump contract)
-    "json": {"frontend.py", "tracing.py", "flight_recorder.py"},
-}
-
-
-def _stdlib(root: str) -> bool:
-    return root in sys.stdlib_module_names
-
-
-def _allowed(root: str, fname: str) -> bool:
-    if root in _SCOPED_ROOTS:
-        return fname in _SCOPED_ROOTS[root]
-    return _stdlib(root) or root in _ALLOWED_ROOTS
 
 
 def test_serving_imports_only_jax_numpy_stdlib():
@@ -605,28 +572,13 @@ def test_serving_imports_only_jax_numpy_stdlib():
     stdlib (asyncio/http/socket, plus json) is scoped to the front end:
     a scheduler or engine change that starts talking to the network
     fails HERE, not in a security review."""
-    import paddle_tpu.serving as pkg
+    from paddle_tpu.analysis import run
 
-    pkg_dir = os.path.dirname(pkg.__file__)
-    offenders = []
-    for fname in sorted(os.listdir(pkg_dir)):
-        if not fname.endswith(".py"):
-            continue
-        tree = ast.parse(open(os.path.join(pkg_dir, fname)).read())
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    root = alias.name.split(".")[0]
-                    if not _allowed(root, fname):
-                        offenders.append((fname, alias.name))
-            elif isinstance(node, ast.ImportFrom):
-                if node.level > 0:         # relative: stays in paddle_tpu
-                    continue
-                root = (node.module or "").split(".")[0]
-                if not _allowed(root, fname):
-                    offenders.append((fname, node.module))
-    assert not offenders, \
-        f"disallowed/mis-scoped absolute imports: {offenders}"
+    findings = [f for f in run(rules=["import-guard"],
+                               paths=["paddle_tpu/serving"])
+                if f.active]
+    assert not findings, "disallowed/mis-scoped absolute imports:\n" + \
+        "\n".join(f.format() for f in findings)
 
 
 def test_int4_kv_helpers_import_only_jax_numpy_stdlib():
@@ -634,25 +586,14 @@ def test_int4_kv_helpers_import_only_jax_numpy_stdlib():
     (ops/quant_ops.py, r14) sit on the serving-critical import path — the
     same no-new-deps discipline applies: jax/numpy/stdlib only, with
     paddle_tpu-relative imports free."""
+    from paddle_tpu.analysis import run
     from paddle_tpu.ops import quant_ops
 
-    fname = os.path.basename(quant_ops.__file__)
-    tree = ast.parse(open(quant_ops.__file__).read())
-    offenders = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                root = alias.name.split(".")[0]
-                if not (_stdlib(root) or root in _ALLOWED_ROOTS):
-                    offenders.append((fname, alias.name))
-        elif isinstance(node, ast.ImportFrom):
-            if node.level > 0:
-                continue
-            root = (node.module or "").split(".")[0]
-            if not (_stdlib(root) or root in _ALLOWED_ROOTS
-                    or root == "paddle_tpu"):
-                offenders.append((fname, node.module))
-    assert not offenders, f"disallowed absolute imports: {offenders}"
+    findings = [f for f in run(rules=["import-guard"],
+                               paths=["paddle_tpu/ops/quant_ops.py"])
+                if f.active]
+    assert not findings, "disallowed absolute imports:\n" + \
+        "\n".join(f.format() for f in findings)
     for helper in ("pack_int4", "unpack_int4", "quantize_int4_per_token",
                    "quantize_per_token"):
         assert callable(getattr(quant_ops, helper))

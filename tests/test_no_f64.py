@@ -6,11 +6,10 @@ f64 on TPU (2x HBM, off the MXU fast path).  This gate traces the flagship
 hybrid train step — embeddings, dropout rng, flash/sdpa, CE, AdamW — and
 asserts no non-scalar f64 value exists anywhere in the jaxpr."""
 
-import re
-
 import numpy as np
 
 import paddle_tpu as paddle
+from paddle_tpu.analysis.jaxpr_audit import find_f64
 from paddle_tpu.distributed import fleet
 from paddle_tpu.models import GPTForPretraining
 from paddle_tpu.models.gpt import GPTConfig, build_functional_train_step
@@ -33,9 +32,8 @@ def test_flagship_step_has_no_f64_arrays():
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 256, (4, 16)).astype("int32")
     labels = rng.randint(0, 256, (4, 16)).astype("int64")
-    jaxpr = str(jax.make_jaxpr(step)(params, opt, ids, labels))
-    bad = sorted({m for m in re.findall(r"f64\[[^\]]*\]", jaxpr)
-                  if m != "f64[]"})
+    jaxpr = jax.make_jaxpr(step)(params, opt, ids, labels)
+    bad = find_f64(jaxpr)      # scalar f64[] excluded: weak-typed noise
     assert not bad, (
         f"float64 arrays leaked into the flagship train step: {bad} — "
         f"an op is promoting under the global x64 flag (check rng draws, "

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu import optimizer as opt
+# primitive walks (pallas bodies excluded) live in the analysis package
+from paddle_tpu.analysis.jaxpr_audit import collect_primitives
 from paddle_tpu.kernels import flash
 from paddle_tpu.models.gpt import (
     GPTConfig,
@@ -236,25 +238,6 @@ def test_beam_search_tokens_identical():
 # ---------------------------------------------------------------------------
 
 
-def _collect_primitives(jaxpr, acc):
-    """All primitive names reachable OUTSIDE the Pallas kernel bodies — a
-    transpose inside pallas_call is the kernel's own VMEM-tile math (k.T on
-    the MXU), not a layout change around the custom call."""
-    for eqn in jaxpr.eqns:
-        acc.add(eqn.primitive.name)
-        if eqn.primitive.name == "pallas_call":
-            continue
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            for u in vs:
-                inner = getattr(u, "jaxpr", None)
-                if inner is not None and hasattr(inner, "eqns"):
-                    _collect_primitives(inner, acc)
-                elif hasattr(u, "eqns"):
-                    _collect_primitives(u, acc)
-    return acc
-
-
 def test_no_transpose_between_model_and_flash_kernel(monkeypatch):
     """Acceptance probe: trace GPTAttention.forward (seq-major, flash path
     forced) and assert the jaxpr reaches the Pallas kernel without a single
@@ -279,7 +262,7 @@ def test_no_transpose_between_model_and_flash_kernel(monkeypatch):
                 lambda a: attn(Tensor(a, stop_gradient=True))._array)(x0)
         finally:
             tracer.set_grad_enabled(og)
-        return _collect_primitives(jaxpr.jaxpr, set())
+        return collect_primitives(jaxpr)
 
     prims_s = probe(attn_s, (512, 2, 64))   # [S, B, H]
     assert "pallas_call" in prims_s, sorted(prims_s)
